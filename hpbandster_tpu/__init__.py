@@ -1,0 +1,50 @@
+"""hpbandster_tpu — a TPU-native hyperparameter-optimization framework.
+
+Re-implements the full capability surface of HpBandSter (HyperBand + BOHB +
+RandomSearch over an elastic master/worker pool; see SURVEY.md) with a
+TPU-first architecture:
+
+* the successive-halving bracket math and the BOHB KDE model are pure,
+  jittable functions over arrays (``hpbandster_tpu.ops``),
+* config evaluation can run as one large batched/sharded computation on a
+  ``jax.sharding.Mesh`` (``hpbandster_tpu.parallel.VmapBackend``) instead of
+  one config per RPC round-trip,
+* the reference's asynchronous master/worker protocol is preserved as the
+  host (DCN) tier — a Pyro4-free TCP nameserver/dispatcher/worker stack —
+  so heterogeneous external (non-JAX) workers still interoperate.
+
+Reference behavior parity is documented per-module against SURVEY.md
+(the upstream mount was empty; see the provenance warning there).
+"""
+
+__version__ = "0.1.0"
+
+# Lazy top-level re-exports: keep `import hpbandster_tpu.space` cheap (no JAX
+# import) while still offering the reference-style flat API
+# (`hpbandster_tpu.BOHB`, `.Worker`, `.NameServer`, ...).
+_EXPORTS = {
+    "Result": "hpbandster_tpu.core.result",
+    "Run": "hpbandster_tpu.core.result",
+    "json_result_logger": "hpbandster_tpu.core.result",
+    "logged_results_to_HBS_result": "hpbandster_tpu.core.result",
+    "Worker": "hpbandster_tpu.core.worker",
+    "NameServer": "hpbandster_tpu.core.nameserver",
+    "BOHB": "hpbandster_tpu.optimizers",
+    "HyperBand": "hpbandster_tpu.optimizers",
+    "RandomSearch": "hpbandster_tpu.optimizers",
+}
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(_EXPORTS[name])
+        value = getattr(mod, name)
+        globals()[name] = value
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -1,0 +1,35 @@
+"""Search-space module: typed hyperparameters + unit-hypercube array codec.
+
+Self-contained replacement for the reference's external ConfigSpace
+dependency (SURVEY.md §2 L0 / "Config / flag system").
+"""
+
+from hpbandster_tpu.space.hyperparameters import (  # noqa: F401
+    Hyperparameter,
+    UniformFloatHyperparameter,
+    UniformIntegerHyperparameter,
+    CategoricalHyperparameter,
+    OrdinalHyperparameter,
+    Constant,
+)
+from hpbandster_tpu.space.conditions import (  # noqa: F401
+    Condition,
+    EqualsCondition,
+    NotEqualsCondition,
+    InCondition,
+    GreaterThanCondition,
+    LessThanCondition,
+    AndConjunction,
+    OrConjunction,
+)
+from hpbandster_tpu.space.forbidden import (  # noqa: F401
+    ForbiddenClause,
+    ForbiddenEqualsClause,
+    ForbiddenInClause,
+    ForbiddenAndConjunction,
+)
+from hpbandster_tpu.space.configspace import (  # noqa: F401
+    Configuration,
+    ConfigurationSpace,
+    VARTYPE_CODES,
+)

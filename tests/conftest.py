@@ -1,0 +1,24 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+Mirrors the survey's test recipe (SURVEY.md §4): multi-chip sharding is
+exercised on a faked host-platform mesh so the suite runs anywhere; the real
+TPU path is covered by bench.py / __graft_entry__.py on hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,224 @@
+"""Tests for hpbandster_tpu.space: codec round-trips, conditions, forbiddens."""
+
+import numpy as np
+import pytest
+
+from hpbandster_tpu.space import (
+    AndConjunction,
+    CategoricalHyperparameter,
+    ConfigurationSpace,
+    Constant,
+    EqualsCondition,
+    ForbiddenAndConjunction,
+    ForbiddenEqualsClause,
+    GreaterThanCondition,
+    InCondition,
+    OrdinalHyperparameter,
+    UniformFloatHyperparameter,
+    UniformIntegerHyperparameter,
+)
+
+
+def make_flat_space(seed=3):
+    cs = ConfigurationSpace(seed=seed)
+    cs.add_hyperparameter(UniformFloatHyperparameter("lr", 1e-5, 1e-1, log=True))
+    cs.add_hyperparameter(UniformFloatHyperparameter("momentum", 0.0, 0.99))
+    cs.add_hyperparameter(UniformIntegerHyperparameter("layers", 1, 8))
+    cs.add_hyperparameter(CategoricalHyperparameter("act", ["relu", "tanh", "gelu"]))
+    cs.add_hyperparameter(OrdinalHyperparameter("width", [64, 128, 256, 512]))
+    return cs
+
+
+class TestHyperparameters:
+    def test_float_roundtrip(self):
+        hp = UniformFloatHyperparameter("x", -2.0, 6.0)
+        for v in [-2.0, 0.0, 3.3, 6.0]:
+            assert hp.from_unit(hp.to_unit(v)) == pytest.approx(v, abs=1e-9)
+
+    def test_log_float_roundtrip(self):
+        hp = UniformFloatHyperparameter("lr", 1e-6, 1.0, log=True)
+        for v in [1e-6, 1e-3, 0.5, 1.0]:
+            assert hp.from_unit(hp.to_unit(v)) == pytest.approx(v, rel=1e-9)
+        # log-uniform: midpoint of unit interval is the geometric mean
+        assert hp.from_unit(0.5) == pytest.approx(1e-3, rel=1e-6)
+
+    def test_quantized_float(self):
+        hp = UniformFloatHyperparameter("q", 0.0, 1.0, q=0.25)
+        assert hp.from_unit(0.4) in (0.25, 0.5)
+        assert hp.from_unit(hp.to_unit(0.75)) == 0.75
+
+    def test_int_roundtrip_and_uniformity(self, rng):
+        hp = UniformIntegerHyperparameter("n", 3, 12)
+        for v in range(3, 13):
+            assert hp.from_unit(hp.to_unit(v)) == v
+        # uniform unit samples must decode ~uniformly over the range
+        us = rng.uniform(size=20000)
+        counts = np.bincount([hp.from_unit(u) - 3 for u in us], minlength=10)
+        assert counts.min() > 0.8 * 2000 and counts.max() < 1.2 * 2000
+
+    def test_log_int_roundtrip(self):
+        hp = UniformIntegerHyperparameter("bs", 1, 1024, log=True)
+        for v in [1, 2, 7, 128, 1024]:
+            assert hp.from_unit(hp.to_unit(v)) == v
+
+    def test_categorical(self, rng):
+        hp = CategoricalHyperparameter("c", ["a", "b", "c"])
+        assert hp.to_unit("b") == 1.0
+        assert hp.from_unit(1.0) == "b"
+        assert hp.from_unit(2.4) == "c"  # clipped+rounded
+        assert hp.vartype == "u" and hp.num_choices == 3
+
+    def test_categorical_weights(self, rng):
+        hp = CategoricalHyperparameter("c", ["a", "b"], weights=[0.9, 0.1])
+        draws = [hp.sample(rng) for _ in range(2000)]
+        assert draws.count("a") > 1600
+
+    def test_ordinal(self):
+        hp = OrdinalHyperparameter("w", [16, 32, 64])
+        assert hp.vartype == "o"
+        assert hp.to_unit(32) == 1.0 and hp.from_unit(2.0) == 64
+
+    def test_constant(self):
+        hp = Constant("k", "fixed")
+        assert hp.from_unit(0.0) == "fixed"
+        assert hp.to_unit("fixed") == 0.0
+        with pytest.raises(ValueError):
+            hp.to_unit("other")
+
+    def test_validation_errors(self):
+        with pytest.raises(ValueError):
+            UniformFloatHyperparameter("bad", 1.0, 0.0)
+        with pytest.raises(ValueError):
+            UniformFloatHyperparameter("bad", -1.0, 1.0, log=True)
+        with pytest.raises(ValueError):
+            CategoricalHyperparameter("bad", [])
+
+
+class TestConfigurationSpace:
+    def test_vector_roundtrip(self, rng):
+        cs = make_flat_space()
+        for cfg in cs.sample_configuration(50):
+            vec = cs.to_vector(cfg)
+            assert vec.shape == (5,)
+            assert np.isfinite(vec).all()
+            back = cs.from_vector(vec)
+            assert back["act"] == cfg["act"]
+            assert back["width"] == cfg["width"]
+            assert back["layers"] == cfg["layers"]
+            assert back["lr"] == pytest.approx(cfg["lr"], rel=1e-9)
+
+    def test_vartypes_and_cardinalities(self):
+        cs = make_flat_space()
+        assert cs.vartypes().tolist() == [0, 0, 0, 1, 2]
+        assert cs.cardinalities().tolist() == [0, 0, 0, 3, 4]
+
+    def test_sampling_reproducible(self):
+        a = make_flat_space(seed=7).sample_configuration(5)
+        b = make_flat_space(seed=7).sample_configuration(5)
+        assert a == b
+
+    def test_get_dictionary_compat(self):
+        cs = make_flat_space()
+        cfg = cs.sample_configuration()
+        assert cfg.get_dictionary() == dict(cfg)
+
+    def test_duplicate_rejected(self):
+        cs = make_flat_space()
+        with pytest.raises(ValueError):
+            cs.add_hyperparameter(UniformFloatHyperparameter("lr", 0, 1))
+
+    def test_sample_vectors_batch(self):
+        cs = make_flat_space()
+        X = cs.sample_vectors(32)
+        assert X.shape == (32, 5)
+        # continuous dims in [0,1]; categorical dims are integer indices
+        assert ((X[:, :3] >= 0) & (X[:, :3] <= 1)).all()
+        assert set(np.unique(X[:, 3])) <= {0.0, 1.0, 2.0}
+
+
+class TestConditions:
+    def make_conditional_space(self, seed=0):
+        cs = ConfigurationSpace(seed=seed)
+        opt = cs.add_hyperparameter(
+            CategoricalHyperparameter("optimizer", ["sgd", "adam"])
+        )
+        mom = cs.add_hyperparameter(UniformFloatHyperparameter("momentum", 0.0, 1.0))
+        b2 = cs.add_hyperparameter(UniformFloatHyperparameter("beta2", 0.9, 0.999))
+        nest = cs.add_hyperparameter(
+            CategoricalHyperparameter("nesterov", [True, False])
+        )
+        cs.add_condition(EqualsCondition(mom, opt, "sgd"))
+        cs.add_condition(EqualsCondition(b2, opt, "adam"))
+        # nesterov active only when sgd AND momentum > 0.5
+        cs.add_condition(
+            AndConjunction(
+                EqualsCondition(nest, opt, "sgd"),
+                GreaterThanCondition(nest, mom, 0.5),
+            )
+        )
+        return cs
+
+    def test_activity(self):
+        cs = self.make_conditional_space()
+        for cfg in cs.sample_configuration(100):
+            if cfg["optimizer"] == "sgd":
+                assert "momentum" in cfg and "beta2" not in cfg
+                assert ("nesterov" in cfg) == (cfg["momentum"] > 0.5)
+            else:
+                assert "beta2" in cfg and "momentum" not in cfg
+                assert "nesterov" not in cfg
+
+    def test_inactive_dims_are_nan(self):
+        cs = self.make_conditional_space()
+        cfg = next(
+            c for c in cs.sample_configuration(100) if c["optimizer"] == "adam"
+        )
+        vec = cs.to_vector(cfg)
+        names = cs.get_hyperparameter_names()
+        assert np.isnan(vec[names.index("momentum")])
+        assert np.isnan(vec[names.index("nesterov")])
+        assert np.isfinite(vec[names.index("beta2")])
+
+    def test_vector_decode_prunes_inactive(self):
+        cs = self.make_conditional_space()
+        # a vector claiming adam but with momentum filled in: decode must drop it
+        names = cs.get_hyperparameter_names()
+        vec = np.zeros(4)
+        vec[names.index("optimizer")] = 1.0  # adam
+        vec[names.index("momentum")] = 0.7
+        vec[names.index("beta2")] = 0.5
+        vec[names.index("nesterov")] = 0.0
+        cfg = cs.from_vector(vec)
+        assert cfg["optimizer"] == "adam"
+        assert "momentum" not in cfg and "nesterov" not in cfg
+
+    def test_in_condition(self):
+        cs = ConfigurationSpace(seed=1)
+        a = cs.add_hyperparameter(CategoricalHyperparameter("a", ["x", "y", "z"]))
+        b = cs.add_hyperparameter(UniformFloatHyperparameter("b", 0, 1))
+        cs.add_condition(InCondition(b, a, ["x", "y"]))
+        for cfg in cs.sample_configuration(60):
+            assert ("b" in cfg) == (cfg["a"] in ("x", "y"))
+
+    def test_cycle_detection(self):
+        cs = ConfigurationSpace()
+        a = cs.add_hyperparameter(CategoricalHyperparameter("a", [0, 1]))
+        b = cs.add_hyperparameter(CategoricalHyperparameter("b", [0, 1]))
+        cs.add_condition(EqualsCondition(b, a, 1))
+        cs.add_condition(EqualsCondition(a, b, 1))
+        with pytest.raises(ValueError):
+            cs.sample_configuration()
+
+
+class TestForbidden:
+    def test_forbidden_sampling(self):
+        cs = ConfigurationSpace(seed=2)
+        a = cs.add_hyperparameter(CategoricalHyperparameter("a", ["p", "q"]))
+        b = cs.add_hyperparameter(CategoricalHyperparameter("b", ["r", "s"]))
+        cs.add_forbidden_clause(
+            ForbiddenAndConjunction(
+                ForbiddenEqualsClause(a, "p"), ForbiddenEqualsClause(b, "r")
+            )
+        )
+        for cfg in cs.sample_configuration(200):
+            assert not (cfg["a"] == "p" and cfg["b"] == "r")
