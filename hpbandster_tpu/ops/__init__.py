@@ -1,0 +1,20 @@
+"""Pure jittable array kernels: bracket math and the BOHB KDE model."""
+
+from hpbandster_tpu.ops.bracket import (  # noqa: F401
+    BracketPlan,
+    budget_ladder,
+    hyperband_bracket,
+    hyperband_schedule,
+    max_sh_iterations,
+    sh_promotion_mask,
+    sh_resample_mask,
+)
+from hpbandster_tpu.ops.kde import (  # noqa: F401
+    KDE,
+    LOG_PDF_FLOOR,
+    kde_logpdf,
+    normal_reference_bandwidths,
+    propose,
+    propose_batch,
+    sample_around,
+)

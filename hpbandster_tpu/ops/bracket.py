@@ -1,0 +1,132 @@
+"""Pure successive-halving / HyperBand bracket arithmetic.
+
+The reference scatters this math across ``optimizers/hyperband.py`` /
+``optimizers/bohb.py`` (ladder + bracket sizing) and
+``optimizers/iterations/successivehalving.py`` (the promotion rule) — see
+SURVEY.md §2 rows "HyperBand optimizer" and "SuccessiveHalving iteration".
+Here it lives as standalone pure functions: host-side schedule construction
+(static shapes, plain numpy) and a jittable / vmappable promotion kernel.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "max_sh_iterations",
+    "budget_ladder",
+    "BracketPlan",
+    "hyperband_bracket",
+    "hyperband_schedule",
+    "sh_promotion_mask",
+    "sh_resample_mask",
+]
+
+
+def max_sh_iterations(min_budget: float, max_budget: float, eta: float) -> int:
+    """Number of distinct successive-halving bracket shapes.
+
+    Reference: ``max_SH_iter = floor(log(max/min)/log(eta)) + 1``
+    (SURVEY.md §3.1, BOHB.__init__).
+    """
+    if not (max_budget > 0 and min_budget > 0 and max_budget >= min_budget):
+        raise ValueError(f"need 0 < min_budget <= max_budget, got [{min_budget}, {max_budget}]")
+    if eta <= 1:
+        raise ValueError(f"need eta > 1, got {eta}")
+    return int(np.floor(np.log(max_budget / min_budget) / np.log(eta))) + 1
+
+
+def budget_ladder(min_budget: float, max_budget: float, eta: float) -> np.ndarray:
+    """Ascending geometric budget ladder ending exactly at ``max_budget``.
+
+    Reference: ``budgets = max_budget * eta ** (-linspace(max_SH_iter-1, 0))``.
+    """
+    k = max_sh_iterations(min_budget, max_budget, eta)
+    return max_budget * np.power(float(eta), -np.arange(k - 1, -1, -1, dtype=np.float64))
+
+
+class BracketPlan(NamedTuple):
+    """Static description of one successive-halving bracket."""
+
+    #: configs alive at each stage, e.g. [9, 3, 1]
+    num_configs: Tuple[int, ...]
+    #: budget evaluated at each stage (same length)
+    budgets: Tuple[float, ...]
+
+    @property
+    def n_stages(self) -> int:
+        return len(self.num_configs)
+
+    @property
+    def total_evaluations(self) -> int:
+        return int(sum(self.num_configs))
+
+
+def hyperband_bracket(
+    iteration_index: int, min_budget: float, max_budget: float, eta: float
+) -> BracketPlan:
+    """The bracket HyperBand runs at global iteration ``iteration_index``.
+
+    Reference arithmetic (SURVEY.md §2 "HyperBand optimizer"):
+    ``s = max_SH_iter - 1 - (i % max_SH_iter)``;
+    ``n0 = ceil(max_SH_iter / (s+1) * eta**s)``;
+    ``ns = [max(floor(n0 * eta**(-j)), 1) for j in 0..s]``;
+    budgets are the last ``s+1`` rungs of the ladder.
+    """
+    k = max_sh_iterations(min_budget, max_budget, eta)
+    ladder = budget_ladder(min_budget, max_budget, eta)
+    s = k - 1 - (iteration_index % k)
+    n0 = int(math.ceil((k / (s + 1)) * eta**s))
+    ns = tuple(max(int(n0 * eta ** (-j)), 1) for j in range(s + 1))
+    budgets = tuple(float(b) for b in ladder[-(s + 1):])
+    return BracketPlan(num_configs=ns, budgets=budgets)
+
+
+def hyperband_schedule(
+    n_iterations: int, min_budget: float, max_budget: float, eta: float
+) -> Tuple[BracketPlan, ...]:
+    """Plans for ``n_iterations`` consecutive HyperBand iterations."""
+    return tuple(
+        hyperband_bracket(i, min_budget, max_budget, eta) for i in range(n_iterations)
+    )
+
+
+def sh_promotion_mask(losses: jax.Array, k) -> jax.Array:
+    """The successive-halving promotion rule as a pure jittable kernel.
+
+    ``losses`` is ``f32[n]`` for one finished stage (NaN = crashed config);
+    returns ``bool[n]`` marking the ``k`` best (lowest-loss) configs.
+
+    Reference rule (SURVEY.md §3.3): ``ranks = argsort(argsort(losses));
+    advance = ranks < k`` — NaNs (crashed runs) rank last because they are
+    replaced by ``+inf`` before ranking, matching the reference's
+    crashed-config-never-promoted behavior. ``vmap`` over a leading bracket
+    axis batches many brackets' promotions into one dispatch.
+    """
+    losses = jnp.asarray(losses)
+    clean = jnp.where(jnp.isnan(losses), jnp.inf, losses)
+    ranks = jnp.argsort(jnp.argsort(clean))
+    return ranks < k
+
+
+def sh_resample_mask(
+    losses: jax.Array, k, resampling_rate: float, key: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """SuccessiveResampling variant (SURVEY.md §2): promote only
+    ``ceil(k * (1 - resampling_rate))`` survivors; the caller fills the rest of
+    the next stage with fresh samples.
+
+    Returns ``(promote_mask, n_resampled)``.
+    """
+    del key  # selection is deterministic; the resample draw happens upstream
+    losses = jnp.asarray(losses)
+    n_promote = jnp.maximum(
+        jnp.ceil(k * (1.0 - resampling_rate)).astype(jnp.int32), 1
+    )
+    mask = sh_promotion_mask(losses, n_promote)
+    return mask, jnp.asarray(k, jnp.int32) - n_promote

@@ -1,0 +1,235 @@
+"""Mixed-type kernel-density estimation + TPE-style proposal, in pure JAX.
+
+Re-implements the model math of the reference's BOHB config generator
+(SURVEY.md §2 "BOHB config generator (KDE)" and §3.4) — which there is a
+Python loop over ``statsmodels.KDEMultivariate`` pdf calls — as jittable,
+vmappable array kernels:
+
+* product kernels per statsmodels convention: Gaussian for continuous dims,
+  Aitchison–Aitken for unordered categoricals, Wang–van Ryzin for ordinals;
+* normal-reference ("Scott/Silverman") bandwidths;
+* truncated-normal / keep-or-resample candidate sampling around good points;
+* the ``l(x)/g(x)`` acquisition maximized over ``num_samples`` candidates.
+
+Shapes are static: observation sets are padded to a fixed capacity with a
+0/1 mask, so a growing observation history causes at most ``log2`` many
+recompilations. A whole stage of proposals is one ``vmap`` over keys — this
+is the batched path the rebuild's north star asks for (SURVEY.md §0).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import logsumexp, ndtr, ndtri
+
+__all__ = [
+    "KDE",
+    "LOG_PDF_FLOOR",
+    "normal_reference_bandwidths",
+    "kde_logpdf",
+    "sample_around",
+    "propose",
+    "propose_batch",
+]
+
+#: reference clips pdf values at 1e-32 before the ratio (SURVEY.md §3.4)
+LOG_PDF_FLOOR = math.log(1e-32)
+
+_LOG_SQRT_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+class KDE(NamedTuple):
+    """A fitted mixed-type KDE over unit-hypercube observation vectors.
+
+    ``data`` is ``f32[capacity, d]`` (imputed — no NaNs), ``mask`` is
+    ``f32[capacity]`` with 1 for real observations, ``bw`` is ``f32[d]``.
+    """
+
+    data: jax.Array
+    mask: jax.Array
+    bw: jax.Array
+
+
+def _discrete_bw_cap(cards: jax.Array) -> jax.Array:
+    """Aitchison–Aitken lambda must stay below (k-1)/k; continuous dims uncapped."""
+    cards_f = jnp.maximum(cards.astype(jnp.float32), 2.0)
+    cap = (cards_f - 1.0) / cards_f
+    return jnp.where(cards > 0, cap, jnp.inf)
+
+
+def normal_reference_bandwidths(
+    data: jax.Array,
+    mask: jax.Array,
+    cards: jax.Array,
+    min_bandwidth: float = 1e-3,
+) -> jax.Array:
+    """Per-dim normal-reference rule: ``1.059 * sigma_j * n^(-1/(d+4))``.
+
+    Matches statsmodels' ``bw='normal_reference'`` default that the reference
+    relies on, with the reference's ``min_bandwidth`` floor applied to every
+    dim and the Aitchison–Aitken cap applied to discrete dims.
+    """
+    data = jnp.asarray(data, jnp.float32)
+    mask = jnp.asarray(mask, jnp.float32)
+    d = data.shape[-1]
+    n = jnp.maximum(mask.sum(), 1.0)
+    mean = (data * mask[:, None]).sum(0) / n
+    var = (jnp.square(data - mean) * mask[:, None]).sum(0) / n
+    sigma = jnp.sqrt(jnp.maximum(var, 0.0))
+    bw = 1.059 * sigma * n ** (-1.0 / (4.0 + d))
+    bw = jnp.clip(bw, min_bandwidth, _discrete_bw_cap(cards))
+    return bw
+
+
+def _per_dim_log_kernels(
+    x: jax.Array,
+    data: jax.Array,
+    bw: jax.Array,
+    vartypes: jax.Array,
+    cards: jax.Array,
+) -> jax.Array:
+    """log kernel value for each (datum, dim) pair; shape ``[capacity, d]``.
+
+    vartypes codes: 0 continuous (Gaussian), 1 unordered (Aitchison–Aitken),
+    2 ordered (Wang–van Ryzin) — see space.VARTYPE_CODES.
+    """
+    diff = x[None, :] - data  # [cap, d]
+    bw = jnp.clip(bw, 1e-10, None)
+
+    # Gaussian, normalized
+    log_c = -0.5 * jnp.square(diff / bw) - jnp.log(bw) - _LOG_SQRT_2PI
+
+    same = jnp.abs(diff) < 0.5  # discrete dims hold integer codes
+    lam = jnp.clip(bw, 1e-10, 1.0 - 1e-7)
+    km1 = jnp.maximum(cards.astype(jnp.float32) - 1.0, 1.0)
+
+    # Aitchison–Aitken: 1-lam if match else lam/(k-1)
+    log_u = jnp.where(same, jnp.log1p(-lam), jnp.log(lam) - jnp.log(km1))
+
+    # Wang–van Ryzin: 1-lam if match else 0.5*(1-lam)*lam^|x-xi|
+    log_o = jnp.where(
+        same,
+        jnp.log1p(-lam),
+        math.log(0.5) + jnp.log1p(-lam) + jnp.abs(diff) * jnp.log(lam),
+    )
+
+    vt = vartypes[None, :]
+    return jnp.where(vt == 0, log_c, jnp.where(vt == 1, log_u, log_o))
+
+
+def kde_logpdf(
+    x: jax.Array,
+    kde: KDE,
+    vartypes: jax.Array,
+    cards: jax.Array,
+) -> jax.Array:
+    """Mixture log-density of one point under the product-kernel KDE."""
+    log_k = _per_dim_log_kernels(x, kde.data, kde.bw, vartypes, cards)  # [cap, d]
+    per_datum = log_k.sum(-1)  # [cap]
+    log_w = jnp.where(kde.mask > 0, 0.0, -jnp.inf)
+    n = jnp.maximum(kde.mask.sum(), 1.0)
+    return logsumexp(per_datum + log_w) - jnp.log(n)
+
+
+def _truncnorm_unit(key: jax.Array, mean: jax.Array, sd: jax.Array) -> jax.Array:
+    """Truncated-normal sample on [0, 1] via inverse-CDF (vectorized over dims)."""
+    sd = jnp.clip(sd, 1e-6, None)
+    a = ndtr((0.0 - mean) / sd)
+    b = ndtr((1.0 - mean) / sd)
+    u = jax.random.uniform(key, mean.shape, minval=a, maxval=b)
+    u = jnp.clip(u, 1e-7, 1.0 - 1e-7)
+    return jnp.clip(mean + sd * ndtri(u), 0.0, 1.0)
+
+
+def sample_around(
+    key: jax.Array,
+    datum: jax.Array,
+    bw: jax.Array,
+    vartypes: jax.Array,
+    cards: jax.Array,
+    bandwidth_factor: float = 3.0,
+    min_bandwidth: float = 1e-3,
+) -> jax.Array:
+    """One BOHB candidate: perturb a good observation per-dim.
+
+    Continuous dims: truncnorm(mean=datum, sd=bw*bandwidth_factor) on [0,1];
+    discrete dims: keep the datum's value w.p. (1-bw), else uniform over the
+    other choices — the reference's sampling scheme (SURVEY.md §3.4).
+    """
+    k_cont, k_keep, k_cat = jax.random.split(key, 3)
+    sd = jnp.clip(bw * bandwidth_factor, min_bandwidth, None)
+    cont = _truncnorm_unit(k_cont, datum, sd)
+
+    lam = jnp.clip(bw, 0.0, 1.0 - 1e-7)
+    keep = jax.random.uniform(k_keep, datum.shape) >= lam
+    cards_safe = jnp.maximum(cards, 1)
+    rand_choice = jax.random.uniform(k_cat, datum.shape) * cards_safe.astype(jnp.float32)
+    rand_choice = jnp.clip(jnp.floor(rand_choice), 0, cards_safe - 1).astype(jnp.float32)
+    disc = jnp.where(keep, datum, rand_choice)
+
+    return jnp.where(vartypes == 0, cont, disc)
+
+
+@partial(jax.jit, static_argnames=("num_samples",))
+def propose(
+    key: jax.Array,
+    good: KDE,
+    bad: KDE,
+    vartypes: jax.Array,
+    cards: jax.Array,
+    num_samples: int = 64,
+    bandwidth_factor: float = 3.0,
+    min_bandwidth: float = 1e-3,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One BOHB proposal: the best of ``num_samples`` candidates by l(x)/g(x).
+
+    Returns ``(best_vector, candidates, scores)``; scores are
+    ``log l(x) - log g(x)`` with both log-densities floored at
+    ``LOG_PDF_FLOOR`` exactly like the reference's ``max(1e-32, pdf)`` clamp.
+    """
+    k_idx, k_samp = jax.random.split(key)
+    logits = jnp.where(good.mask > 0, 0.0, -jnp.inf)
+    idx = jax.random.categorical(k_idx, logits, shape=(num_samples,))
+    data = good.data[idx]  # [S, d]
+
+    keys = jax.random.split(k_samp, num_samples)
+    cands = jax.vmap(
+        lambda k, x: sample_around(
+            k, x, good.bw, vartypes, cards, bandwidth_factor, min_bandwidth
+        )
+    )(keys, data)
+
+    lg = jax.vmap(lambda c: kde_logpdf(c, good, vartypes, cards))(cands)
+    lb = jax.vmap(lambda c: kde_logpdf(c, bad, vartypes, cards))(cands)
+    scores = jnp.maximum(lg, LOG_PDF_FLOOR) - jnp.maximum(lb, LOG_PDF_FLOOR)
+
+    best = cands[jnp.argmax(scores)]
+    return best, cands, scores
+
+
+@partial(jax.jit, static_argnames=("num_samples",))
+def propose_batch(
+    keys: jax.Array,
+    good: KDE,
+    bad: KDE,
+    vartypes: jax.Array,
+    cards: jax.Array,
+    num_samples: int = 64,
+    bandwidth_factor: float = 3.0,
+    min_bandwidth: float = 1e-3,
+) -> jax.Array:
+    """A whole stage of proposals in one dispatch: vmap of :func:`propose`.
+
+    ``keys`` is ``[n, 2]`` (uint32 key batch); returns ``f32[n, d]``. This is
+    the vmapped replacement for the reference's one-proposal-per-RPC loop.
+    """
+    return jax.vmap(
+        lambda k: propose(
+            k, good, bad, vartypes, cards, num_samples, bandwidth_factor, min_bandwidth
+        )[0]
+    )(keys)

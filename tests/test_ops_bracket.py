@@ -1,0 +1,88 @@
+"""Tests for the pure bracket math (schedule + promotion kernels)."""
+
+import numpy as np
+import pytest
+
+from hpbandster_tpu.ops import (
+    budget_ladder,
+    hyperband_bracket,
+    hyperband_schedule,
+    max_sh_iterations,
+    sh_promotion_mask,
+    sh_resample_mask,
+)
+
+
+class TestSchedule:
+    def test_max_sh_iterations(self):
+        assert max_sh_iterations(1, 9, 3) == 3
+        assert max_sh_iterations(1, 81, 3) == 5
+        assert max_sh_iterations(1, 1, 3) == 1
+        # reference BOHB defaults: min=0.01, max=1, eta=3 -> 5 rungs
+        assert max_sh_iterations(0.01, 1.0, 3) == 5
+
+    def test_budget_ladder(self):
+        np.testing.assert_allclose(budget_ladder(1, 9, 3), [1.0, 3.0, 9.0])
+        lad = budget_ladder(0.01, 1.0, 3)
+        assert len(lad) == 5
+        assert lad[-1] == pytest.approx(1.0)
+        np.testing.assert_allclose(lad[1:] / lad[:-1], 3.0)
+
+    def test_eta3_brackets(self):
+        # classic eta=3, budgets {1,3,9}: the three bracket shapes
+        b0 = hyperband_bracket(0, 1, 9, 3)
+        assert b0.num_configs == (9, 3, 1)
+        assert b0.budgets == (1.0, 3.0, 9.0)
+        b1 = hyperband_bracket(1, 1, 9, 3)
+        assert b1.num_configs == (5, 1)
+        assert b1.budgets == (3.0, 9.0)
+        b2 = hyperband_bracket(2, 1, 9, 3)
+        assert b2.num_configs == (3,)
+        assert b2.budgets == (9.0,)
+        # cycles with period max_SH_iter
+        assert hyperband_bracket(3, 1, 9, 3) == b0
+
+    def test_schedule_totals(self):
+        plans = hyperband_schedule(6, 1, 9, 3)
+        assert len(plans) == 6
+        assert [p.total_evaluations for p in plans[:3]] == [13, 6, 3]
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            max_sh_iterations(0, 1, 3)
+        with pytest.raises(ValueError):
+            max_sh_iterations(1, 9, 1.0)
+
+
+class TestPromotion:
+    def test_basic_topk(self):
+        losses = np.array([0.5, 0.1, 0.9, 0.3], dtype=np.float32)
+        mask = np.asarray(sh_promotion_mask(losses, 2))
+        assert mask.tolist() == [False, True, False, True]
+
+    def test_nan_never_promoted(self):
+        losses = np.array([np.nan, 0.1, np.nan, 0.3], dtype=np.float32)
+        mask = np.asarray(sh_promotion_mask(losses, 2))
+        assert mask.tolist() == [False, True, False, True]
+        # even if k exceeds the clean count, NaNs rank strictly last
+        mask3 = np.asarray(sh_promotion_mask(losses, 3))
+        assert mask3[1] and mask3[3] and mask3.sum() == 3
+
+    def test_vmap_over_brackets(self):
+        import jax
+
+        losses = np.array(
+            [[0.3, 0.1, 0.2], [0.9, 0.8, 0.7]], dtype=np.float32
+        )
+        masks = np.asarray(jax.vmap(lambda l: sh_promotion_mask(l, 1))(losses))
+        assert masks[0].tolist() == [False, True, False]
+        assert masks[1].tolist() == [False, False, True]
+
+    def test_resample_mask(self):
+        import jax
+
+        losses = np.array([0.4, 0.1, 0.2, 0.9], dtype=np.float32)
+        mask, n_res = sh_resample_mask(losses, 2, 0.5, jax.random.key(0))
+        # ceil(2 * 0.5) = 1 promoted, 1 resampled
+        assert np.asarray(mask).sum() == 1 and int(n_res) == 1
+        assert bool(np.asarray(mask)[1])

@@ -1,0 +1,201 @@
+"""Tests for the JAX KDE: validated against brute-force numpy references
+(the same math statsmodels' KDEMultivariate implements, which the reference
+depends on — SURVEY.md §2 "BOHB config generator")."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hpbandster_tpu.ops import (
+    KDE,
+    kde_logpdf,
+    normal_reference_bandwidths,
+    propose,
+    propose_batch,
+    sample_around,
+)
+
+
+def np_mixed_kde_pdf(x, data, bw, vartypes, cards):
+    """Brute-force product-kernel mixture density in numpy."""
+    total = 0.0
+    for xi in data:
+        p = 1.0
+        for j in range(len(x)):
+            if vartypes[j] == 0:
+                h = bw[j]
+                p *= math.exp(-0.5 * ((x[j] - xi[j]) / h) ** 2) / (
+                    h * math.sqrt(2 * math.pi)
+                )
+            elif vartypes[j] == 1:  # Aitchison-Aitken
+                lam = bw[j]
+                k = cards[j]
+                p *= (1 - lam) if round(x[j]) == round(xi[j]) else lam / (k - 1)
+            else:  # Wang-van Ryzin
+                lam = bw[j]
+                d = abs(x[j] - xi[j])
+                p *= (1 - lam) if d < 0.5 else 0.5 * (1 - lam) * lam**d
+        total += p
+    return total / len(data)
+
+
+def padded(data, capacity):
+    data = np.asarray(data, np.float32)
+    n, d = data.shape
+    out = np.zeros((capacity, d), np.float32)
+    out[:n] = data
+    mask = np.zeros(capacity, np.float32)
+    mask[:n] = 1.0
+    return out, mask
+
+
+class TestBandwidths:
+    def test_normal_reference_continuous(self, rng):
+        data = rng.uniform(size=(40, 3)).astype(np.float32)
+        cards = np.zeros(3, np.int32)
+        dpad, mask = padded(data, 64)
+        bw = np.asarray(normal_reference_bandwidths(dpad, mask, cards))
+        expected = 1.059 * data.std(axis=0) * 40 ** (-1 / 7)
+        np.testing.assert_allclose(bw, expected, rtol=1e-4)
+
+    def test_min_bandwidth_floor(self):
+        data = np.full((10, 2), 0.5, np.float32)  # zero variance
+        dpad, mask = padded(data, 16)
+        bw = np.asarray(
+            normal_reference_bandwidths(dpad, mask, np.zeros(2, np.int32), 1e-3)
+        )
+        np.testing.assert_allclose(bw, 1e-3)
+
+    def test_categorical_cap(self, rng):
+        # huge spread on a 3-way categorical dim: lambda capped at (k-1)/k
+        data = rng.choice(3, size=(4, 1)).astype(np.float32) * 100
+        dpad, mask = padded(data, 8)
+        bw = np.asarray(
+            normal_reference_bandwidths(dpad, mask, np.array([3], np.int32))
+        )
+        assert bw[0] <= 2 / 3 + 1e-6
+
+    def test_padding_invariance(self, rng):
+        data = rng.uniform(size=(10, 2)).astype(np.float32)
+        cards = np.zeros(2, np.int32)
+        bw16 = np.asarray(normal_reference_bandwidths(*padded(data, 16), cards))
+        bw64 = np.asarray(normal_reference_bandwidths(*padded(data, 64), cards))
+        np.testing.assert_allclose(bw16, bw64, rtol=1e-6)
+
+
+class TestLogpdf:
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_matches_numpy_continuous(self, seed):
+        rng = np.random.default_rng(seed)
+        data = rng.uniform(size=(20, 4))
+        bw = np.array([0.1, 0.2, 0.05, 0.3], np.float32)
+        vt = np.zeros(4, np.int32)
+        cards = np.zeros(4, np.int32)
+        dpad, mask = padded(data, 32)
+        kde = KDE(jnp.asarray(dpad), jnp.asarray(mask), jnp.asarray(bw))
+        for _ in range(5):
+            x = rng.uniform(size=4).astype(np.float32)
+            got = float(kde_logpdf(jnp.asarray(x), kde, vt, cards))
+            want = math.log(np_mixed_kde_pdf(x, data, bw, vt, cards))
+            assert got == pytest.approx(want, rel=1e-4)
+
+    def test_matches_numpy_mixed(self):
+        rng = np.random.default_rng(3)
+        cont = rng.uniform(size=(15, 2))
+        cat = rng.choice(3, size=(15, 1))
+        order = rng.choice(4, size=(15, 1))
+        data = np.concatenate([cont, cat, order], axis=1)
+        bw = np.array([0.15, 0.1, 0.4, 0.3], np.float32)
+        vt = np.array([0, 0, 1, 2], np.int32)
+        cards = np.array([0, 0, 3, 4], np.int32)
+        dpad, mask = padded(data, 16)
+        kde = KDE(jnp.asarray(dpad), jnp.asarray(mask), jnp.asarray(bw))
+        for _ in range(5):
+            x = np.concatenate(
+                [rng.uniform(size=2), rng.choice(3, size=1), rng.choice(4, size=1)]
+            ).astype(np.float32)
+            got = float(kde_logpdf(jnp.asarray(x), kde, vt, cards))
+            want = math.log(np_mixed_kde_pdf(x, data, bw, vt, cards))
+            assert got == pytest.approx(want, rel=1e-4)
+
+    def test_padding_invariance(self):
+        rng = np.random.default_rng(4)
+        data = rng.uniform(size=(9, 3))
+        bw = np.full(3, 0.2, np.float32)
+        vt = cards = np.zeros(3, np.int32)
+        x = jnp.asarray(rng.uniform(size=3), jnp.float32)
+        v16 = float(kde_logpdf(x, KDE(*map(jnp.asarray, padded(data, 16)), jnp.asarray(bw)), vt, cards))
+        v64 = float(kde_logpdf(x, KDE(*map(jnp.asarray, padded(data, 64)), jnp.asarray(bw)), vt, cards))
+        assert v16 == pytest.approx(v64, rel=1e-5)
+
+
+class TestSampling:
+    def test_truncnorm_stays_in_unit_and_near_mean(self):
+        key = jax.random.key(0)
+        datum = jnp.array([0.5, 0.9, 0.1], jnp.float32)
+        bw = jnp.array([0.05, 0.05, 0.05], jnp.float32)
+        vt = jnp.zeros(3, jnp.int32)
+        cards = jnp.zeros(3, jnp.int32)
+        samples = np.asarray(
+            jax.vmap(lambda k: sample_around(k, datum, bw, vt, cards, 1.0))(
+                jax.random.split(key, 200)
+            )
+        )
+        assert (samples >= 0).all() and (samples <= 1).all()
+        np.testing.assert_allclose(samples.mean(0), np.asarray(datum), atol=0.03)
+
+    def test_categorical_keep_probability(self):
+        key = jax.random.key(1)
+        datum = jnp.array([2.0], jnp.float32)
+        bw = jnp.array([0.3], jnp.float32)  # lambda = 0.3 -> keep w.p. 0.7
+        vt = jnp.array([1], jnp.int32)
+        cards = jnp.array([4], jnp.int32)
+        samples = np.asarray(
+            jax.vmap(lambda k: sample_around(k, datum, bw, vt, cards))(
+                jax.random.split(key, 2000)
+            )
+        ).ravel()
+        keep_rate = (samples == 2.0).mean()
+        # keep w.p. (1-lam) plus lam/k chance of re-drawing the same value
+        assert keep_rate == pytest.approx(0.7 + 0.3 / 4, abs=0.04)
+        assert set(np.unique(samples)) <= {0.0, 1.0, 2.0, 3.0}
+
+
+class TestPropose:
+    def _two_cluster_kdes(self):
+        rng = np.random.default_rng(7)
+        good = 0.2 + 0.02 * rng.standard_normal((12, 2))
+        bad = 0.8 + 0.02 * rng.standard_normal((12, 2))
+        cards = np.zeros(2, np.int32)
+        gd, gm = padded(good, 16)
+        bd, bm = padded(bad, 16)
+        g = KDE(jnp.asarray(gd), jnp.asarray(gm),
+                normal_reference_bandwidths(gd, gm, cards))
+        b = KDE(jnp.asarray(bd), jnp.asarray(bm),
+                normal_reference_bandwidths(bd, bm, cards))
+        return g, b, np.zeros(2, np.int32), cards
+
+    def test_proposals_prefer_good_region(self):
+        g, b, vt, cards = self._two_cluster_kdes()
+        best, cands, scores = propose(jax.random.key(0), g, b, vt, cards)
+        assert cands.shape == (64, 2) and scores.shape == (64,)
+        # the argmax candidate must sit in the good cluster
+        assert np.linalg.norm(np.asarray(best) - 0.2) < 0.3
+
+    def test_propose_batch_shapes_and_quality(self):
+        g, b, vt, cards = self._two_cluster_kdes()
+        keys = jax.random.split(jax.random.key(1), 32)
+        batch = np.asarray(propose_batch(keys, g, b, vt, cards))
+        assert batch.shape == (32, 2)
+        dists_good = np.linalg.norm(batch - 0.2, axis=1)
+        dists_bad = np.linalg.norm(batch - 0.8, axis=1)
+        assert (dists_good < dists_bad).mean() > 0.9
+
+    def test_deterministic_under_same_key(self):
+        g, b, vt, cards = self._two_cluster_kdes()
+        b1, _, _ = propose(jax.random.key(5), g, b, vt, cards)
+        b2, _, _ = propose(jax.random.key(5), g, b, vt, cards)
+        np.testing.assert_array_equal(np.asarray(b1), np.asarray(b2))
