@@ -1,0 +1,16 @@
+"""Core runtime: jobs, iterations, master loop, results."""
+
+from hpbandster_tpu.core.job import Job  # noqa: F401
+from hpbandster_tpu.core.iteration import BaseIteration, Datum, Status  # noqa: F401
+from hpbandster_tpu.core.successive_halving import (  # noqa: F401
+    SuccessiveHalving,
+    SuccessiveResampling,
+)
+from hpbandster_tpu.core.master import Master  # noqa: F401
+from hpbandster_tpu.core.result import (  # noqa: F401
+    Result,
+    Run,
+    extract_HBS_learning_curves,
+    json_result_logger,
+    logged_results_to_HBS_result,
+)

@@ -1,0 +1,47 @@
+"""Job — one (config, budget) evaluation travelling through the system.
+
+Mirrors the reference's ``Job`` record (SURVEY.md §2 "Dispatcher" row):
+config id + kwargs, submitted/started/finished wall-clock timestamps, and a
+result-or-exception outcome. The timestamp schema is preserved verbatim so
+``Result`` analysis and the JSONL log format stay compatible.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["Job"]
+
+ConfigId = Tuple[int, int, int]
+
+
+class Job:
+    def __init__(self, id: ConfigId, **kwargs: Any):
+        self.id: ConfigId = tuple(id)  # type: ignore[assignment]
+        self.kwargs: Dict[str, Any] = kwargs
+        self.timestamps: Dict[str, float] = {}
+        self.result: Optional[Dict[str, Any]] = None
+        self.exception: Optional[str] = None
+        self.worker_name: Optional[str] = None
+
+    def time_it(self, which_time: str) -> "Job":
+        """Record a wall-clock timestamp ('submitted' | 'started' | 'finished')."""
+        self.timestamps[which_time] = time.time()
+        return self
+
+    @property
+    def loss(self) -> float:
+        """The scalar loss, or NaN for crashed/invalid results."""
+        if self.result is None:
+            return float("nan")
+        try:
+            return float(self.result["loss"])
+        except (KeyError, TypeError, ValueError):
+            return float("nan")
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"Job(id={self.id}, budget={self.kwargs.get('budget')}, "
+            f"result={self.result!r}, exception={self.exception!r})"
+        )

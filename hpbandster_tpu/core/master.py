@@ -1,0 +1,236 @@
+"""Master — the optimizer main loop.
+
+Reference semantics preserved (SURVEY.md §2 "Master" row, §3.1/§3.3):
+owns a job executor + config generator + list of iteration objects;
+``run()`` waits for workers, pulls ready runs from active iterations, creates
+new iterations up to ``n_iterations``, submits jobs, and sleeps on a
+condition variable when the in-flight queue is full; ``job_callback``
+registers results, updates the model, and advances brackets.
+
+The executor seam is this rebuild's key generalization: the same Master
+drives either the asynchronous host worker pool (``parallel.Dispatcher``,
+the reference's architecture) or the batched on-device TPU path
+(``parallel.BatchedExecutor``) where a whole wave of configs is one sharded
+XLA computation. Batched executors buffer submitted jobs and evaluate them
+when the Master drains its ready queue and calls ``flush()``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from hpbandster_tpu.core.iteration import BaseIteration
+from hpbandster_tpu.core.job import ConfigId, Job
+from hpbandster_tpu.core.result import Result
+from hpbandster_tpu.core.warmstart import WarmStartIteration
+
+__all__ = ["Master"]
+
+
+class Master:
+    def __init__(
+        self,
+        run_id: str,
+        config_generator,
+        executor=None,
+        working_directory: str = ".",
+        logger: Optional[logging.Logger] = None,
+        result_logger=None,
+        previous_result: Optional[Result] = None,
+        job_queue_sizes: Tuple[int, int] = (-1, 0),
+        dynamic_queue_size: bool = True,
+        # reference-compatible nameserver kwargs; used only when no executor
+        # is passed explicitly and a Dispatcher must be constructed:
+        nameserver: str = "127.0.0.1",
+        nameserver_port: Optional[int] = None,
+        host: Optional[str] = None,
+        ping_interval: float = 60.0,
+        shutdown_workers: bool = True,
+    ):
+        self.run_id = run_id
+        self.config_generator = config_generator
+        self.working_directory = working_directory
+        self.logger = logger or logging.getLogger("hpbandster_tpu.master")
+        self.result_logger = result_logger
+
+        self.iterations: List[BaseIteration] = []
+        self.jobs: List[Job] = []
+        self.num_running_jobs = 0
+        self.job_queue_sizes = job_queue_sizes
+        self.dynamic_queue_size = dynamic_queue_size
+        if job_queue_sizes[0] >= job_queue_sizes[1]:
+            raise ValueError("job_queue_sizes: need lower < upper")
+
+        self.time_ref: Optional[float] = None
+        self.config: Dict[str, Any] = {"time_ref": None}
+
+        # re-entrant: batched executors fire job_callback synchronously from
+        # inside flush(), which runs under this same condition
+        self.thread_cond = threading.Condition(threading.RLock())
+
+        self.warmstart_iteration: List[Any] = []
+        if previous_result is not None:
+            self.warmstart_iteration = [
+                WarmStartIteration(previous_result, self.config_generator)
+            ]
+
+        if executor is None:
+            from hpbandster_tpu.parallel.dispatcher import Dispatcher
+
+            executor = Dispatcher(
+                run_id=run_id,
+                nameserver=nameserver,
+                nameserver_port=nameserver_port,
+                host=host,
+                ping_interval=ping_interval,
+            )
+        self.executor = executor
+        self.executor.start(
+            new_result_callback=self.job_callback,
+            new_worker_callback=self.adjust_queue_size,
+        )
+        if getattr(self.executor, "unbounded_queue", False):
+            self.dynamic_queue_size = False
+            self.job_queue_sizes = (-1, float("inf"))
+        # how many brackets may run concurrently before buffered work is
+        # evaluated. Batched executors prefer 1 (each bracket's samples then
+        # see all earlier results — the most sample-efficient, and each stage
+        # is still one big device batch); async pools default to unlimited,
+        # matching the reference's create-iterations-freely behavior.
+        self.parallel_brackets: float = getattr(
+            self.executor, "preferred_parallel_brackets", float("inf")
+        )
+
+    # ----------------------------------------------------------------- hooks
+    def get_next_iteration(
+        self, iteration: int, iteration_kwargs: Dict[str, Any]
+    ) -> BaseIteration:
+        """Instantiate the next bracket — implemented by optimizer subclasses."""
+        raise NotImplementedError
+
+    # -------------------------------------------------------------- plumbing
+    def adjust_queue_size(self, number_of_workers: Optional[int] = None) -> None:
+        """Retarget the in-flight window to the worker count (reference:
+        ``dynamic_queue_size``; queue = (n_workers-1, n_workers))."""
+        with self.thread_cond:
+            n = (
+                number_of_workers
+                if number_of_workers is not None
+                else self.executor.number_of_workers()
+            )
+            if self.dynamic_queue_size:
+                self.job_queue_sizes = (max(n - 1, 0), max(n, 1))
+                self.logger.debug("queue sizes adjusted to %s", self.job_queue_sizes)
+            self.thread_cond.notify_all()
+
+    def job_callback(self, job: Job) -> None:
+        """Result ingestion: log -> iteration bookkeeping -> model update ->
+        stage advancement -> wake the run loop (reference §3.3)."""
+        with self.thread_cond:
+            self.num_running_jobs -= 1
+            if self.result_logger is not None:
+                self.result_logger(job)
+            self.iterations[job.id[0]].register_result(job)
+            self.config_generator.new_result(job)
+            self.iterations[job.id[0]].process_results()
+            if self.num_running_jobs <= self.job_queue_sizes[0]:
+                self.thread_cond.notify_all()
+
+    def _submit_job(self, config_id: ConfigId, config: Dict[str, Any], budget: float) -> None:
+        job = Job(
+            config_id,
+            config=config,
+            budget=budget,
+            working_directory=self.working_directory,
+        )
+        job.time_it("submitted")
+        with self.thread_cond:
+            self.num_running_jobs += 1
+            self.jobs.append(job)
+        self.executor.submit_job(job)
+
+    def active_iterations(self) -> List[int]:
+        return [i for i, it in enumerate(self.iterations) if not it.is_finished]
+
+    def wait_for_workers(self, min_n_workers: int) -> None:
+        while self.executor.number_of_workers() < min_n_workers:
+            self.logger.debug(
+                "waiting for workers: %d/%d",
+                self.executor.number_of_workers(), min_n_workers,
+            )
+            time.sleep(0.05)
+
+    # ------------------------------------------------------------------- run
+    def run(
+        self,
+        n_iterations: int = 1,
+        min_n_workers: int = 1,
+        iteration_kwargs: Optional[Dict[str, Any]] = None,
+    ) -> Result:
+        """Drive ``n_iterations`` brackets to completion and return a Result."""
+        iteration_kwargs = dict(iteration_kwargs or {})
+        self.wait_for_workers(min_n_workers)
+        self.adjust_queue_size()
+
+        if self.time_ref is None:
+            self.time_ref = time.time()
+            self.config["time_ref"] = self.time_ref
+        iteration_kwargs.setdefault("result_logger", self.result_logger)
+
+        n_remaining = n_iterations
+        while True:
+            with self.thread_cond:
+                # respect the in-flight window (async executors)
+                while self.num_running_jobs > self.job_queue_sizes[1]:
+                    self.thread_cond.wait(0.5)
+
+                next_run = None
+                for i in self.active_iterations():
+                    next_run = self.iterations[i].get_next_run()
+                    if next_run is not None:
+                        break
+
+                if next_run is not None:
+                    self.logger.debug("submitting job %s", next_run[0])
+                    self._submit_job(*next_run)
+                    continue
+
+                if (
+                    n_remaining > 0
+                    and len(self.active_iterations()) < self.parallel_brackets
+                ):
+                    self.iterations.append(
+                        self.get_next_iteration(len(self.iterations), iteration_kwargs)
+                    )
+                    n_remaining -= 1
+                    continue
+
+                # nothing ready: let batched executors evaluate their buffer
+                # (fires job_callback synchronously under this RLock) before
+                # any new bracket samples — so fresh proposals see the
+                # latest model state
+                if hasattr(self.executor, "flush") and self.executor.flush():
+                    continue
+
+                if n_remaining > 0:
+                    self.iterations.append(
+                        self.get_next_iteration(len(self.iterations), iteration_kwargs)
+                    )
+                    n_remaining -= 1
+                    continue
+
+                if not self.active_iterations() and self.num_running_jobs == 0:
+                    break
+
+                self.thread_cond.wait(0.5)
+
+        return Result(
+            [i for i in self.iterations] + self.warmstart_iteration, self.config
+        )
+
+    def shutdown(self, shutdown_workers: bool = False) -> None:
+        self.logger.debug("master shutdown (workers=%s)", shutdown_workers)
+        self.executor.shutdown(shutdown_workers)

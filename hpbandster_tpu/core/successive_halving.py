@@ -1,0 +1,56 @@
+"""Concrete iteration types: SuccessiveHalving and SuccessiveResampling.
+
+The promotion rules live as jittable kernels in ``ops/bracket.py``; these
+classes only adapt them to the Datum bookkeeping. Reference counterparts:
+``optimizers/iterations/successivehalving.py`` and
+``successiveresampling.py`` (SURVEY.md §2).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from hpbandster_tpu.core.iteration import BaseIteration
+from hpbandster_tpu.core.job import ConfigId
+from hpbandster_tpu.ops.bracket import sh_promotion_mask
+
+__all__ = ["SuccessiveHalving", "SuccessiveResampling"]
+
+
+class SuccessiveHalving(BaseIteration):
+    """Promote the best ``num_configs[next_stage]`` configs by loss rank."""
+
+    def _advance_to_next_stage(
+        self, config_ids: List[ConfigId], losses: np.ndarray
+    ) -> np.ndarray:
+        k = self.num_configs[self.stage + 1]
+        return np.asarray(sh_promotion_mask(losses.astype(np.float32), k))
+
+
+class SuccessiveResampling(BaseIteration):
+    """Promote fewer survivors and refill the gap with fresh samples.
+
+    ``resampling_rate`` is the fraction of the next stage drawn fresh from the
+    config generator instead of promoted (reference variant, SURVEY.md §2
+    "SuccessiveResampling iteration").
+    """
+
+    def __init__(self, *args, resampling_rate: float = 0.5, min_samples_advance: int = 1, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.resampling_rate = float(resampling_rate)
+        self.min_samples_advance = int(min_samples_advance)
+
+    def _advance_to_next_stage(
+        self, config_ids: List[ConfigId], losses: np.ndarray
+    ) -> np.ndarray:
+        k = self.num_configs[self.stage + 1]
+        n_promote = max(
+            int(np.ceil(k * (1.0 - self.resampling_rate))), self.min_samples_advance
+        )
+        # the unfilled remainder of the next stage is topped up by
+        # get_next_run() sampling fresh configs (actual_num_configs < quota)
+        return np.asarray(
+            sh_promotion_mask(losses.astype(np.float32), min(n_promote, k))
+        )

@@ -1,0 +1,5 @@
+"""Config generators: the model seam (random sampling, BOHB KDE)."""
+
+from hpbandster_tpu.models.base import base_config_generator  # noqa: F401
+from hpbandster_tpu.models.random_sampling import RandomSampling  # noqa: F401
+from hpbandster_tpu.models.bohb_kde import BOHBKDE  # noqa: F401

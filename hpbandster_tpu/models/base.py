@@ -1,0 +1,45 @@
+"""base_config_generator — the config-proposal plugin seam.
+
+Interface identical to the reference's
+``core/base_config_generator.py`` (SURVEY.md §2): ``get_config(budget)``
+proposes, ``new_result(job)`` feeds observations back. The rebuild adds
+``get_config_batch`` so batched executors can request a whole stage at once
+(one vmapped dispatch instead of n Python calls).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+from hpbandster_tpu.core.job import Job
+
+__all__ = ["base_config_generator"]
+
+
+class base_config_generator:
+    def __init__(self, logger: Optional[logging.Logger] = None):
+        self.logger = logger or logging.getLogger("hpbandster_tpu.config_generator")
+
+    def get_config(self, budget: float) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Propose one configuration for evaluation at ``budget``.
+
+        Returns ``(config_dict, info_dict)`` — info records provenance
+        (model-based vs random), as the reference does.
+        """
+        raise NotImplementedError
+
+    def get_config_batch(
+        self, budget: float, n: int
+    ) -> List[Tuple[Dict[str, Any], Dict[str, Any]]]:
+        """Propose ``n`` configurations at once (default: loop get_config)."""
+        return [self.get_config(budget) for _ in range(n)]
+
+    def new_result(self, job: Job, update_model: bool = True) -> None:
+        """Register a finished job. Crashed runs (result None) are kept as
+        information — the reference treats them as 'bad' rather than
+        discarding (SURVEY.md §5 failure row)."""
+        if job.exception is not None:
+            self.logger.warning(
+                "job %s raised an exception: %s", job.id, job.exception
+            )

@@ -1,0 +1,40 @@
+"""Random config generator (HyperBand's / RandomSearch's sampler).
+
+Reference: ``optimizers/config_generators/random_sampling.py`` — just
+``config_space.sample_configuration()`` with no model (SURVEY.md §2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from hpbandster_tpu.models.base import base_config_generator
+from hpbandster_tpu.space import ConfigurationSpace
+
+__all__ = ["RandomSampling"]
+
+
+class RandomSampling(base_config_generator):
+    def __init__(
+        self,
+        configspace: ConfigurationSpace,
+        seed: Optional[int] = None,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.configspace = configspace
+        self.rng = np.random.default_rng(seed)
+
+    def get_config(self, budget: float) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        cfg = self.configspace.sample_configuration(rng=self.rng)
+        return dict(cfg), {"model_based_pick": False}
+
+    def get_config_batch(
+        self, budget: float, n: int
+    ) -> List[Tuple[Dict[str, Any], Dict[str, Any]]]:
+        return [
+            (dict(c), {"model_based_pick": False})
+            for c in self.configspace.sample_configuration(n, rng=self.rng)
+        ]

@@ -1,0 +1,5 @@
+"""Optimizers: Master subclasses wiring iterations + config generators."""
+
+from hpbandster_tpu.optimizers.hyperband import HyperBand  # noqa: F401
+from hpbandster_tpu.optimizers.bohb import BOHB  # noqa: F401
+from hpbandster_tpu.optimizers.randomsearch import RandomSearch  # noqa: F401

@@ -1,0 +1,85 @@
+"""BOHB optimizer: HyperBand bracket arithmetic + KDE config generator.
+
+Reference: ``optimizers/bohb.py`` (SURVEY.md §2) — identical knob surface
+(eta, budgets, min_points_in_model, top_n_percent, num_samples,
+random_fraction, bandwidth_factor, min_bandwidth) with the KDE math running
+as jitted JAX kernels (see models/bohb_kde.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from hpbandster_tpu.core.master import Master
+from hpbandster_tpu.core.successive_halving import SuccessiveHalving
+from hpbandster_tpu.models.bohb_kde import BOHBKDE
+from hpbandster_tpu.ops.bracket import budget_ladder, hyperband_bracket, max_sh_iterations
+from hpbandster_tpu.space import ConfigurationSpace
+
+__all__ = ["BOHB"]
+
+
+class BOHB(Master):
+    def __init__(
+        self,
+        configspace: Optional[ConfigurationSpace] = None,
+        eta: float = 3,
+        min_budget: float = 0.01,
+        max_budget: float = 1,
+        min_points_in_model: Optional[int] = None,
+        top_n_percent: int = 15,
+        num_samples: int = 64,
+        random_fraction: float = 1 / 3,
+        bandwidth_factor: float = 3.0,
+        min_bandwidth: float = 1e-3,
+        seed: Optional[int] = None,
+        **kwargs: Any,
+    ):
+        if configspace is None:
+            raise ValueError("you have to provide a valid ConfigurationSpace object")
+        cg = BOHBKDE(
+            configspace=configspace,
+            min_points_in_model=min_points_in_model,
+            top_n_percent=top_n_percent,
+            num_samples=num_samples,
+            random_fraction=random_fraction,
+            bandwidth_factor=bandwidth_factor,
+            min_bandwidth=min_bandwidth,
+            seed=seed,
+        )
+        super().__init__(config_generator=cg, **kwargs)
+
+        self.configspace = configspace
+        self.eta = float(eta)
+        self.min_budget = float(min_budget)
+        self.max_budget = float(max_budget)
+        self.max_SH_iter = max_sh_iterations(min_budget, max_budget, eta)
+        self.budgets = budget_ladder(min_budget, max_budget, eta)
+
+        self.config.update(
+            {
+                "eta": self.eta,
+                "min_budget": self.min_budget,
+                "max_budget": self.max_budget,
+                "budgets": list(self.budgets),
+                "max_SH_iter": self.max_SH_iter,
+                "min_points_in_model": cg.min_points_in_model,
+                "top_n_percent": top_n_percent,
+                "num_samples": num_samples,
+                "random_fraction": random_fraction,
+                "bandwidth_factor": bandwidth_factor,
+                "min_bandwidth": min_bandwidth,
+            }
+        )
+
+    def get_next_iteration(
+        self, iteration: int, iteration_kwargs: Dict[str, Any]
+    ) -> SuccessiveHalving:
+        plan = hyperband_bracket(iteration, self.min_budget, self.max_budget, self.eta)
+        return SuccessiveHalving(
+            HPB_iter=iteration,
+            num_configs=list(plan.num_configs),
+            budgets=list(plan.budgets),
+            config_sampler=self.config_generator.get_config,
+            **iteration_kwargs,
+        )

@@ -1,0 +1,15 @@
+"""Parallel execution tiers.
+
+ICI tier: batched on-device evaluation over a device mesh
+(``VmapBackend`` + ``BatchedExecutor``). DCN tier: the asynchronous host
+worker pool (``Dispatcher`` + ``NameServer`` + ``Worker``), preserving the
+reference's elastic master/worker semantics (SURVEY.md §2).
+"""
+
+from hpbandster_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    config_mesh,
+    config_model_mesh,
+)
+from hpbandster_tpu.parallel.backends import VmapBackend  # noqa: F401
+from hpbandster_tpu.parallel.batched_executor import BatchedExecutor  # noqa: F401

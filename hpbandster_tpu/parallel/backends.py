@@ -1,0 +1,104 @@
+"""Batched evaluation backends: configs -> losses as one XLA computation.
+
+This is the north-star component (SURVEY.md §0): where the reference
+evaluates strictly one config per worker per Pyro4 RPC, these backends
+evaluate a whole wave of configurations as a single jitted, sharded
+dispatch — vmapped over the config batch, sharded over the 'config' axis of
+a device mesh, with per-config crash masking via non-finite losses.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["VmapBackend"]
+
+
+class VmapBackend:
+    """Evaluate a jittable objective over a batch of config vectors.
+
+    ``eval_fn(config_vector: f32[d], budget: f32[]) -> loss: f32[]`` must be
+    traceable by JAX (use ``lax`` control flow for budget-dependent loops).
+    Budgets arrive as a *traced* scalar by default so one compilation covers
+    every rung of the budget ladder; pass ``static_budget=True`` when the fn
+    needs the budget as a Python number (e.g. a static trip count) — that
+    costs one recompile per distinct budget, of which there are only
+    ``max_SH_iter``.
+
+    With a mesh, the batch is sharded over ``axis`` and each device evaluates
+    its shard; without one, a single-device ``jit(vmap(...))`` runs. Batch
+    sizes are padded to the next power of two (and to a multiple of the mesh
+    size) so recompilation stays logarithmic in the largest stage.
+    """
+
+    def __init__(
+        self,
+        eval_fn: Callable[[jax.Array, jax.Array], jax.Array],
+        mesh: Optional[Mesh] = None,
+        axis: str = "config",
+        static_budget: bool = False,
+        min_pad: int = 8,
+    ):
+        self.eval_fn = eval_fn
+        self.mesh = mesh
+        self.axis = axis
+        self.static_budget = bool(static_budget)
+        self.min_pad = int(min_pad)
+        self._compiled: Dict[Any, Callable] = {}
+
+    # ------------------------------------------------------------------ info
+    @property
+    def parallelism(self) -> int:
+        if self.mesh is not None:
+            return int(np.prod(list(self.mesh.shape.values())))
+        return 1
+
+    def _padded_size(self, n: int) -> int:
+        size = self.min_pad
+        while size < n:
+            size *= 2
+        if self.mesh is not None:
+            m = self.parallelism
+            size = ((size + m - 1) // m) * m
+        return size
+
+    # ------------------------------------------------------------------ jit
+    def _build(self, n_pad: int, budget_static: Optional[float]) -> Callable:
+        def batch_fn(vectors: jax.Array, budget: jax.Array) -> jax.Array:
+            if budget_static is not None:
+                losses = jax.vmap(lambda v: self.eval_fn(v, budget_static))(vectors)
+            else:
+                losses = jax.vmap(lambda v: self.eval_fn(v, budget))(vectors)
+            return losses.astype(jnp.float32)
+
+        if self.mesh is not None:
+            shard = NamedSharding(self.mesh, PartitionSpec(self.axis))
+            rep = NamedSharding(self.mesh, PartitionSpec())
+            return jax.jit(
+                batch_fn,
+                in_shardings=(shard, rep),
+                out_shardings=shard,
+            )
+        return jax.jit(batch_fn)
+
+    def evaluate(self, vectors: np.ndarray, budget: float) -> np.ndarray:
+        """``f32[n, d]`` config vectors -> ``f32[n]`` losses (NaN = crashed)."""
+        vectors = np.asarray(vectors, np.float32)
+        n, d = vectors.shape
+        n_pad = self._padded_size(n)
+        key = (n_pad, d, float(budget) if self.static_budget else None)
+        if key not in self._compiled:
+            self._compiled[key] = self._build(
+                n_pad, float(budget) if self.static_budget else None
+            )
+        padded = np.zeros((n_pad, d), np.float32)
+        padded[:n] = vectors
+        losses = self._compiled[key](
+            jnp.asarray(padded), jnp.float32(budget)
+        )
+        return np.asarray(losses)[:n]

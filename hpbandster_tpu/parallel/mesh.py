@@ -1,0 +1,47 @@
+"""Device-mesh helpers for the batched evaluation path.
+
+The rebuild's scale axis is the *config batch* (SURVEY.md §5 "long-context"
+row: the reference has no sequence dimension; scaling configs-per-bracket is
+the analog). These helpers build 1-D ("config") and 2-D ("config", "model")
+meshes over whatever devices are visible — real TPU chips or the virtual
+CPU devices used in tests.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["config_mesh", "config_model_mesh", "batch_sharding"]
+
+
+def config_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over all devices: every device evaluates a config shard."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.asarray(devices), axis_names=("config",))
+
+
+def config_model_mesh(
+    config_parallel: Optional[int] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """2-D mesh: shard configs over 'config', shard each model over 'model'.
+
+    Used when a single config's training step itself is tensor-sharded
+    (large models) while still batching many configs.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if config_parallel is None:
+        config_parallel = n
+    if n % config_parallel != 0:
+        raise ValueError(f"{n} devices not divisible by config_parallel={config_parallel}")
+    arr = np.asarray(devices).reshape(config_parallel, n // config_parallel)
+    return Mesh(arr, axis_names=("config", "model"))
+
+
+def batch_sharding(mesh: Mesh, axis: str = "config") -> NamedSharding:
+    """Sharding that splits a leading batch dim over ``axis``, replicating rest."""
+    return NamedSharding(mesh, PartitionSpec(axis))

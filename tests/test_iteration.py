@@ -1,0 +1,120 @@
+"""Unit tests for Datum/BaseIteration/SuccessiveHalving bookkeeping."""
+
+import numpy as np
+import pytest
+
+from hpbandster_tpu.core.iteration import Status
+from hpbandster_tpu.core.job import Job
+from hpbandster_tpu.core.successive_halving import (
+    SuccessiveHalving,
+    SuccessiveResampling,
+)
+
+
+def sampler_factory():
+    counter = {"n": 0}
+
+    def sampler(budget):
+        counter["n"] += 1
+        return {"x": float(counter["n"])}, {"model_based_pick": False}
+
+    return sampler, counter
+
+
+def finish(it, config_id, budget, loss=None, exception=None):
+    job = Job(config_id, config=it.data[config_id].config, budget=budget)
+    job.time_it("submitted").time_it("started").time_it("finished")
+    if exception is None:
+        job.result = {"loss": loss, "info": {}}
+    else:
+        job.result = None
+        job.exception = exception
+    it.register_result(job)
+    it.process_results()
+
+
+class TestSuccessiveHalving:
+    def test_full_bracket_lifecycle(self):
+        sampler, counter = sampler_factory()
+        it = SuccessiveHalving(0, [4, 2, 1], [1.0, 3.0, 9.0], sampler)
+
+        # stage 0: hands out exactly 4 runs, sampling on demand
+        runs = [it.get_next_run() for _ in range(4)]
+        assert all(r is not None for r in runs)
+        assert it.get_next_run() is None
+        assert counter["n"] == 4
+        assert {r[2] for r in runs} == {1.0}
+
+        # finish stage 0 with losses 3,1,4,2 -> configs 1 and 3 promote
+        for (cid, _cfg, b), loss in zip(runs, [3.0, 1.0, 4.0, 2.0]):
+            finish(it, cid, b, loss)
+        assert it.stage == 1
+        promoted = [
+            cid for cid, d in it.data.items() if d.status == Status.QUEUED
+        ]
+        assert sorted(p[2] for p in promoted) == [1, 3]
+        # no new sampling at stage 1 — only promotions
+        runs1 = [it.get_next_run() for _ in range(2)]
+        assert counter["n"] == 4
+        assert {r[2] for r in runs1} == {3.0}
+
+        for (cid, _c, b), loss in zip(runs1, [0.5, 0.7]):
+            finish(it, cid, b, loss)
+        assert it.stage == 2
+        (last,) = [it.get_next_run()]
+        finish(it, last[0], last[2], 0.1)
+        assert it.is_finished
+        completed = [d for d in it.data.values() if d.status == Status.COMPLETED]
+        assert len(completed) == 1
+        assert completed[0].results[9.0] == 0.1
+
+    def test_crashed_never_promoted(self):
+        sampler, _ = sampler_factory()
+        it = SuccessiveHalving(0, [3, 1], [1.0, 3.0], sampler)
+        runs = [it.get_next_run() for _ in range(3)]
+        finish(it, runs[0][0], 1.0, exception="boom")
+        finish(it, runs[1][0], 1.0, loss=5.0)
+        finish(it, runs[2][0], 1.0, exception="boom2")
+        assert it.stage == 1
+        nxt = it.get_next_run()
+        assert nxt[0] == runs[1][0]
+        statuses = {cid: d.status for cid, d in it.data.items()}
+        assert statuses[runs[0][0]] == Status.CRASHED
+        assert statuses[runs[2][0]] == Status.CRASHED
+
+    def test_loss_matrix_view(self):
+        sampler, _ = sampler_factory()
+        it = SuccessiveHalving(2, [2, 1], [1.0, 3.0], sampler)
+        runs = [it.get_next_run() for _ in range(2)]
+        finish(it, runs[0][0], 1.0, 1.0)
+        finish(it, runs[1][0], 1.0, 2.0)
+        ids, mat = it.loss_matrix()
+        assert mat.shape == (2, 2)
+        assert np.isnan(mat[:, 1]).all()
+        np.testing.assert_allclose(sorted(mat[:, 0]), [1.0, 2.0])
+
+    def test_budget_mismatch_rejected(self):
+        sampler, _ = sampler_factory()
+        it = SuccessiveHalving(0, [1], [1.0], sampler)
+        cid, cfg, b = it.get_next_run()
+        job = Job(cid, config=cfg, budget=99.0)
+        job.result = {"loss": 0.0}
+        with pytest.raises(RuntimeError):
+            it.register_result(job)
+
+
+class TestSuccessiveResampling:
+    def test_resamples_fresh_configs(self):
+        sampler, counter = sampler_factory()
+        it = SuccessiveResampling(
+            0, [4, 2], [1.0, 3.0], sampler, resampling_rate=0.5
+        )
+        runs = [it.get_next_run() for _ in range(4)]
+        for (cid, _c, b), loss in zip(runs, [1.0, 2.0, 3.0, 4.0]):
+            finish(it, cid, b, loss)
+        assert it.stage == 1
+        # ceil(2 * 0.5) = 1 promoted, so stage 1 samples one fresh config
+        n_before = counter["n"]
+        more = [it.get_next_run(), it.get_next_run()]
+        assert all(m is not None for m in more)
+        assert counter["n"] == n_before + 1
