@@ -1,0 +1,202 @@
+"""Worker — the user-code evaluation plugin, host tier.
+
+API-compatible with the reference's ``core/worker.py`` (SURVEY.md §2):
+subclass, implement ``compute(config_id, config, budget,
+working_directory) -> {'loss': float, 'info': ...}``, then ``run()`` either
+in-process (``background=True``, the test/examples fixture) or as a
+standalone (possibly remote) process that discovers the master through the
+nameserver or a shared-directory credentials file.
+
+Transport is the stdlib TCP RPC layer instead of Pyro4; semantics kept:
+one job at a time, exceptions captured as traceback strings, results pushed
+back to the dispatcher's callback URI, optional idle-timeout self-shutdown.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import socket
+import threading
+import time
+import traceback
+from typing import Any, Dict, Optional
+
+from hpbandster_tpu.parallel.rpc import RPCProxy, RPCServer
+
+__all__ = ["Worker"]
+
+
+class Worker:
+    def __init__(
+        self,
+        run_id: str,
+        nameserver: Optional[str] = None,
+        nameserver_port: Optional[int] = None,
+        logger: Optional[logging.Logger] = None,
+        host: Optional[str] = None,
+        id: Optional[Any] = None,
+        timeout: Optional[float] = None,
+    ):
+        self.run_id = run_id
+        self.nameserver = nameserver
+        self.nameserver_port = nameserver_port
+        self.host = host or "127.0.0.1"
+        self.worker_id = (
+            f"hpbandster.run_{run_id}.worker.{socket.gethostname()}.{os.getpid()}"
+            f".{threading.get_native_id()}"
+        )
+        if id is not None:
+            self.worker_id += f".{id}"
+        self.logger = logger or logging.getLogger(
+            f"hpbandster_tpu.worker.{os.getpid()}"
+        )
+        self.timeout = timeout
+
+        self._server: Optional[RPCServer] = None
+        self._busy_lock = threading.Lock()
+        self._shutdown_event = threading.Event()
+        self._last_active = time.time()
+        self._timeout_thread: Optional[threading.Thread] = None
+
+    # -------------------------------------------------------------- bootstrap
+    def load_nameserver_credentials(
+        self, working_directory: str, num_tries: int = 60, interval: float = 1.0
+    ) -> None:
+        """Poll the shared directory for the nameserver credentials file
+        (cluster bootstrap path, reference §2 NameServer row)."""
+        fn = os.path.join(working_directory, f"HPB_run_{self.run_id}_pyro.pkl")
+        for attempt in range(num_tries):
+            try:
+                with open(fn, "rb") as fh:
+                    self.nameserver, self.nameserver_port = pickle.load(fh)
+                return
+            except FileNotFoundError:
+                self.logger.warning(
+                    "config file %s not found (trying %d/%d)", fn, attempt + 1, num_tries
+                )
+                time.sleep(interval)
+        raise RuntimeError(f"could not find nameserver credentials in {working_directory}")
+
+    # -------------------------------------------------------------- lifecycle
+    def run(self, background: bool = False) -> None:
+        """Serve jobs. ``background=True`` returns immediately (daemon
+        threads), the in-process mode the test suite uses; otherwise blocks
+        until shutdown."""
+        if self.nameserver is None:
+            raise RuntimeError("no nameserver specified (or credentials loaded)")
+        self._server = RPCServer(self.host, 0)
+        self._server.register("start_computation", self._rpc_start_computation)
+        self._server.register("is_busy", self._rpc_is_busy)
+        self._server.register("shutdown", self._rpc_shutdown)
+        self._server.register("ping", lambda: "pong")
+        self._server.start()
+
+        ns = RPCProxy(f"{self.nameserver}:{self.nameserver_port}")
+        ns.call("register", name=self.worker_id, uri=self._server.uri)
+        self.logger.info(
+            "worker %s serving at %s", self.worker_id, self._server.uri
+        )
+
+        if self.timeout is not None:
+            self._timeout_thread = threading.Thread(
+                target=self._timeout_watchdog, daemon=True
+            )
+            self._timeout_thread.start()
+
+        if not background:
+            self._shutdown_event.wait()
+            self._teardown()
+
+    def _timeout_watchdog(self) -> None:
+        while not self._shutdown_event.wait(min(self.timeout, 1.0)):
+            idle = time.time() - self._last_active
+            if not self._busy_lock.locked() and idle > self.timeout:
+                self.logger.info("worker idle for %.1fs; self-shutdown", idle)
+                self.shutdown()
+                return
+
+    def _teardown(self) -> None:
+        try:
+            ns = RPCProxy(f"{self.nameserver}:{self.nameserver_port}", timeout=2)
+            ns.call("unregister", name=self.worker_id)
+        except Exception:
+            pass
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+    def shutdown(self) -> None:
+        self._shutdown_event.set()
+        # when running in background mode nobody waits on the event; tear
+        # down from here (idempotent)
+        if self._server is not None:
+            threading.Thread(target=self._teardown, daemon=True).start()
+
+    # ------------------------------------------------------------ rpc surface
+    def _rpc_is_busy(self) -> bool:
+        return self._busy_lock.locked()
+
+    def _rpc_shutdown(self) -> bool:
+        self.logger.debug("shutdown requested via RPC")
+        self.shutdown()
+        return True
+
+    def _rpc_start_computation(
+        self, callback_uri: str, id: Any, **job_kwargs: Any
+    ) -> bool:
+        if not self._busy_lock.acquire(blocking=False):
+            raise RuntimeError("worker is busy")
+        self._last_active = time.time()
+        thread = threading.Thread(
+            target=self._run_job,
+            args=(callback_uri, tuple(id), job_kwargs),
+            daemon=True,
+            name=f"compute-{id}",
+        )
+        thread.start()
+        return True
+
+    def _run_job(self, callback_uri: str, config_id: Any, job_kwargs: Dict[str, Any]) -> None:
+        result: Optional[Dict[str, Any]] = None
+        exception: Optional[str] = None
+        try:
+            result = self.compute(config_id=config_id, **job_kwargs)
+            if not isinstance(result, dict) or "loss" not in result:
+                raise TypeError(
+                    "compute() must return a dict with a 'loss' key, got "
+                    f"{type(result).__name__}"
+                )
+        except Exception:
+            result = None
+            exception = traceback.format_exc()
+            self.logger.warning("compute crashed:\n%s", exception)
+        finally:
+            self._last_active = time.time()
+            self._busy_lock.release()
+        try:
+            RPCProxy(callback_uri, timeout=30).call(
+                "register_result",
+                id=list(config_id),
+                result={"result": result, "exception": exception},
+            )
+        except Exception:
+            self.logger.error(
+                "could not deliver result for %s:\n%s",
+                config_id, traceback.format_exc(),
+            )
+
+    # --------------------------------------------------------------- user API
+    def compute(
+        self,
+        config_id: Any,
+        config: Dict[str, Any],
+        budget: float,
+        working_directory: str,
+    ) -> Dict[str, Any]:
+        """Evaluate ``config`` at ``budget``; MUST return
+        ``{'loss': float, 'info': <json-serializable>}``."""
+        raise NotImplementedError(
+            "subclass hpbandster_tpu.Worker and implement compute()"
+        )

@@ -1,0 +1,262 @@
+"""Dispatcher — master-side job broker for the elastic host worker pool.
+
+Reference: ``core/dispatcher.py`` (SURVEY.md §2/§3): a discovery loop polls
+the nameserver ~1/s for worker registrations (elastic join/leave), a job
+runner matches queued jobs to idle workers, results arrive via RPC from
+workers and are forwarded to the Master's callback. Vanished workers are
+dropped and their in-flight jobs requeued — the reference's failure
+semantics (SURVEY.md §5 "Failure detection" row).
+
+Implements the same executor seam as ``parallel.BatchedExecutor``, so the
+identical Master drives either tier.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from hpbandster_tpu.core.job import Job
+from hpbandster_tpu.parallel.rpc import (
+    CommunicationError,
+    RPCError,
+    RPCProxy,
+    RPCServer,
+)
+
+__all__ = ["Dispatcher", "WorkerProxy"]
+
+
+class WorkerProxy:
+    """Master-side record of one discovered worker."""
+
+    def __init__(self, name: str, uri: str):
+        self.name = name
+        self.uri = uri
+        self.proxy = RPCProxy(uri, timeout=30)
+        self.runs_job: Optional[Any] = None  # config_id or None
+
+    def is_alive(self) -> bool:
+        try:
+            self.proxy.call("ping")
+            return True
+        except (CommunicationError, RPCError):
+            return False
+
+    def shutdown(self) -> None:
+        try:
+            self.proxy.call("shutdown")
+        except (CommunicationError, RPCError):
+            pass
+
+
+class Dispatcher:
+    def __init__(
+        self,
+        run_id: str,
+        nameserver: str = "127.0.0.1",
+        nameserver_port: Optional[int] = None,
+        host: Optional[str] = None,
+        ping_interval: float = 10.0,
+        discover_interval: float = 1.0,
+        logger: Optional[logging.Logger] = None,
+    ):
+        self.run_id = run_id
+        self.nameserver_uri = f"{nameserver}:{nameserver_port}"
+        self.host = host or "127.0.0.1"
+        self.ping_interval = ping_interval
+        self.discover_interval = discover_interval
+        self.logger = logger or logging.getLogger("hpbandster_tpu.dispatcher")
+
+        self.prefix = f"hpbandster.run_{run_id}.worker."
+        self.workers: Dict[str, WorkerProxy] = {}
+        self.waiting_jobs: List[Job] = []
+        self.running_jobs: Dict[Any, Job] = {}
+
+        self._cond = threading.Condition()
+        self._shutdown_event = threading.Event()
+        self._server: Optional[RPCServer] = None
+        self._threads: List[threading.Thread] = []
+        self._new_result_callback: Optional[Callable[[Job], None]] = None
+        self._new_worker_callback: Optional[Callable[[int], None]] = None
+
+    # --------------------------------------------------------- executor seam
+    def start(
+        self,
+        new_result_callback: Callable[[Job], None],
+        new_worker_callback: Callable[[int], None],
+    ) -> None:
+        self._new_result_callback = new_result_callback
+        self._new_worker_callback = new_worker_callback
+
+        self._server = RPCServer(self.host, 0)
+        self._server.register("register_result", self._rpc_register_result)
+        self._server.register("ping", lambda: "pong")
+        self._server.start()
+
+        for target, name in (
+            (self._discover_loop, "discover"),
+            (self._job_runner_loop, "job-runner"),
+            (self._ping_loop, "ping"),
+        ):
+            t = threading.Thread(
+                target=target, daemon=True, name=f"dispatcher-{name}-{self.run_id}"
+            )
+            t.start()
+            self._threads.append(t)
+
+    def submit_job(self, job: Job) -> None:
+        with self._cond:
+            self.waiting_jobs.append(job)
+            self._cond.notify_all()
+
+    def number_of_workers(self) -> int:
+        with self._cond:
+            return len(self.workers)
+
+    def n_waiting(self) -> int:
+        with self._cond:
+            return len(self.waiting_jobs)
+
+    def shutdown(self, shutdown_workers: bool = False) -> None:
+        self._shutdown_event.set()
+        if shutdown_workers:
+            with self._cond:
+                targets = list(self.workers.values())
+            for w in targets:
+                w.shutdown()
+        with self._cond:
+            self._cond.notify_all()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+    # ------------------------------------------------------------- discovery
+    def _discover_loop(self) -> None:
+        ns = RPCProxy(self.nameserver_uri, timeout=5)
+        while not self._shutdown_event.wait(0.0):
+            try:
+                listing: Dict[str, str] = ns.call("list", prefix=self.prefix)
+            except (CommunicationError, RPCError) as e:
+                self.logger.debug("nameserver unreachable: %r", e)
+                listing = None
+            if listing is not None:
+                self._sync_workers(listing)
+            if self._shutdown_event.wait(self.discover_interval):
+                return
+
+    def _sync_workers(self, listing: Dict[str, str]) -> None:
+        with self._cond:
+            known = set(self.workers)
+        added = 0
+        for name, uri in listing.items():
+            if name in known:
+                continue
+            w = WorkerProxy(name, uri)
+            if not w.is_alive():
+                self.logger.debug("listed worker %s unreachable; skipping", name)
+                continue
+            with self._cond:
+                self.workers[name] = w
+            added += 1
+            self.logger.info("discovered worker %s at %s", name, uri)
+        vanished = known - set(listing)
+        for name in vanished:
+            self._drop_worker(name, reason="unregistered")
+        if added or vanished:
+            with self._cond:
+                n = len(self.workers)
+                self._cond.notify_all()
+            self._new_worker_callback(n)
+
+    def _drop_worker(self, name: str, reason: str) -> None:
+        with self._cond:
+            w = self.workers.pop(name, None)
+            if w is None:
+                return
+            job = self.running_jobs.pop(tuple(w.runs_job), None) if w.runs_job else None
+            if job is not None:
+                # elastic failure handling: requeue the orphaned job
+                self.logger.warning(
+                    "worker %s vanished (%s); requeueing job %s", name, reason, job.id
+                )
+                self.waiting_jobs.insert(0, job)
+            else:
+                self.logger.info("worker %s dropped (%s)", name, reason)
+            self._cond.notify_all()
+
+    def _ping_loop(self) -> None:
+        """Detect workers dying mid-job (requeue their jobs)."""
+        while not self._shutdown_event.wait(self.ping_interval):
+            with self._cond:
+                busy = [
+                    (name, w) for name, w in self.workers.items() if w.runs_job
+                ]
+            for name, w in busy:
+                if not w.is_alive():
+                    self._drop_worker(name, reason="ping failed")
+
+    # ------------------------------------------------------------ job runner
+    def _idle_worker(self) -> Optional[WorkerProxy]:
+        for w in self.workers.values():
+            if w.runs_job is None:
+                return w
+        return None
+
+    def _job_runner_loop(self) -> None:
+        while not self._shutdown_event.is_set():
+            with self._cond:
+                job = None
+                worker = None
+                if self.waiting_jobs:
+                    worker = self._idle_worker()
+                    if worker is not None:
+                        job = self.waiting_jobs.pop(0)
+                        worker.runs_job = job.id
+                        self.running_jobs[tuple(job.id)] = job
+                if job is None:
+                    self._cond.wait(0.2)
+                    continue
+            # RPC outside the lock: the worker spawns a compute thread and
+            # returns immediately
+            job.time_it("started")
+            job.worker_name = worker.name
+            try:
+                worker.proxy.call(
+                    "start_computation",
+                    callback_uri=self._server.uri,
+                    id=list(job.id),
+                    **job.kwargs,
+                )
+                self.logger.debug("job %s -> %s", job.id, worker.name)
+            except (CommunicationError, RPCError) as e:
+                self.logger.warning(
+                    "dispatch of %s to %s failed (%r)", job.id, worker.name, e
+                )
+                with self._cond:
+                    self.running_jobs.pop(tuple(job.id), None)
+                    worker.runs_job = None
+                if isinstance(e, CommunicationError):
+                    self._drop_worker(worker.name, reason="dispatch failed")
+                with self._cond:
+                    self.waiting_jobs.insert(0, job)
+                    self._cond.notify_all()
+
+    # ---------------------------------------------------------- result inflow
+    def _rpc_register_result(self, id: Any, result: Dict[str, Any]) -> bool:
+        cid = tuple(id)
+        with self._cond:
+            job = self.running_jobs.pop(cid, None)
+            if job is None:
+                self.logger.warning("result for unknown job %s ignored", cid)
+                return False
+            for w in self.workers.values():
+                if w.runs_job is not None and tuple(w.runs_job) == cid:
+                    w.runs_job = None
+            self._cond.notify_all()
+        job.time_it("finished")
+        job.result = result.get("result")
+        job.exception = result.get("exception")
+        self._new_result_callback(job)
+        return True

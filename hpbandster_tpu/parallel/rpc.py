@@ -1,0 +1,148 @@
+"""Minimal JSON-over-TCP RPC — the transport under the host worker pool.
+
+Replaces the reference's Pyro4 + serpent substrate (SURVEY.md §2 L0/L1)
+with a dependency-free stdlib implementation: one connection per call,
+newline-delimited JSON frames, exceptions marshalled back as error strings.
+Connection-per-call keeps liveness detection trivial (a vanished peer is a
+``ConnectionError``), which the dispatcher's elastic worker handling relies
+on — the same failure surface Pyro4's ``CommunicationError`` gave the
+reference.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import socketserver
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional, Tuple
+
+__all__ = ["RPCServer", "RPCProxy", "RPCError", "CommunicationError"]
+
+logger = logging.getLogger("hpbandster_tpu.rpc")
+
+_MAX_FRAME = 64 * 1024 * 1024  # 64 MiB per message
+
+
+class RPCError(Exception):
+    """The remote method raised; carries the remote traceback string."""
+
+
+class CommunicationError(Exception):
+    """The peer is unreachable / vanished (connect or read failure)."""
+
+
+def _read_frame(sock: socket.socket) -> Optional[bytes]:
+    chunks = []
+    total = 0
+    while True:
+        chunk = sock.recv(65536)
+        if not chunk:
+            return b"".join(chunks) if chunks else None
+        chunks.append(chunk)
+        total += len(chunk)
+        if total > _MAX_FRAME:
+            raise CommunicationError("frame too large")
+        if chunk.endswith(b"\n"):
+            return b"".join(chunks)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        server: "RPCServer" = self.server  # type: ignore[assignment]
+        try:
+            raw = _read_frame(self.request)
+            if not raw:
+                return
+            msg = json.loads(raw.decode("utf-8"))
+            method = msg.get("method", "")
+            params = msg.get("params", {})
+            fn = server.methods.get(method)
+            if fn is None:
+                reply = {"error": f"unknown method {method!r}"}
+            else:
+                try:
+                    reply = {"result": fn(**params)}
+                except Exception:
+                    reply = {"error": traceback.format_exc()}
+            self.request.sendall(json.dumps(reply).encode("utf-8") + b"\n")
+        except (ConnectionError, OSError, json.JSONDecodeError) as e:
+            logger.debug("rpc handler error: %r", e)
+
+
+class _ThreadingTCPServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class RPCServer:
+    """Serve a dict of callables over TCP; one daemon thread per connection."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.methods: Dict[str, Callable[..., Any]] = {}
+        self._server = _ThreadingTCPServer((host, port), _Handler)
+        self._server.methods = self.methods  # type: ignore[attr-defined]
+        self.host, self.port = self._server.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, name: str, fn: Callable[..., Any]) -> None:
+        self.methods[name] = fn
+
+    def register_instance(self, obj: Any, prefix: str = "") -> None:
+        """Expose every public method of ``obj`` (Pyro4 'expose' analog)."""
+        for name in dir(obj):
+            if name.startswith("_"):
+                continue
+            fn = getattr(obj, name)
+            if callable(fn):
+                self.methods[prefix + name] = fn
+
+    @property
+    def uri(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "RPCServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, kwargs={"poll_interval": 0.1},
+            daemon=True, name=f"rpc-server-{self.port}",
+        )
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+
+class RPCProxy:
+    """Call methods on a remote RPCServer; connection per call."""
+
+    def __init__(self, uri: str, timeout: float = 10.0):
+        host, port = uri.rsplit(":", 1)
+        self.addr: Tuple[str, int] = (host, int(port))
+        self.uri = uri
+        self.timeout = timeout
+
+    def call(self, method: str, **params: Any) -> Any:
+        payload = json.dumps({"method": method, "params": params}).encode("utf-8")
+        try:
+            with socket.create_connection(self.addr, timeout=self.timeout) as sock:
+                sock.sendall(payload + b"\n")
+                raw = _read_frame(sock)
+        except (ConnectionError, OSError) as e:
+            raise CommunicationError(f"cannot reach {self.uri}: {e!r}") from e
+        if not raw:
+            raise CommunicationError(f"{self.uri} closed the connection")
+        reply = json.loads(raw.decode("utf-8"))
+        if "error" in reply:
+            raise RPCError(reply["error"])
+        return reply.get("result")
+
+    def __getattr__(self, name: str) -> Callable[..., Any]:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return lambda **params: self.call(name, **params)

@@ -1,0 +1,6 @@
+"""Host/network utilities (reference: ``hpbandster/utils.py``, SURVEY.md §2)."""
+
+from hpbandster_tpu.utils.network import (  # noqa: F401
+    nic_name_to_host,
+    start_local_nameserver,
+)

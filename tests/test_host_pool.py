@@ -1,0 +1,186 @@
+"""Integration tests for the host (DCN) tier: NameServer + Dispatcher +
+Worker over real localhost TCP — the reference's own integration fixture
+(SURVEY.md §4: 'the real RPC stack runs against 127.0.0.1')."""
+
+import threading
+import time
+
+import pytest
+
+from hpbandster_tpu.core.nameserver import NameServer
+from hpbandster_tpu.core.worker import Worker
+from hpbandster_tpu.optimizers import BOHB, HyperBand
+
+from tests.toys import branin_dict, branin_space
+
+
+class BraninWorker(Worker):
+    def compute(self, config_id, config, budget, working_directory):
+        return {"loss": branin_dict(config, budget), "info": {"budget": budget}}
+
+
+class CrashyWorker(Worker):
+    """Crashes on every config whose x is negative."""
+
+    def compute(self, config_id, config, budget, working_directory):
+        if config["x"] < 0:
+            raise RuntimeError("intentional crash for x<0")
+        return {"loss": branin_dict(config, budget), "info": {}}
+
+
+class SlowWorker(Worker):
+    def compute(self, config_id, config, budget, working_directory):
+        time.sleep(0.05)
+        return {"loss": branin_dict(config, budget), "info": {}}
+
+
+@pytest.fixture
+def ns():
+    ns = NameServer(run_id="t", host="127.0.0.1", port=0)
+    host, port = ns.start()
+    yield ns, host, port
+    ns.shutdown()
+
+
+def start_workers(cls, n, run_id, port, **kwargs):
+    workers = []
+    for i in range(n):
+        w = cls(
+            run_id=run_id, nameserver="127.0.0.1", nameserver_port=port,
+            id=i, **kwargs,
+        )
+        w.run(background=True)
+        workers.append(w)
+    return workers
+
+
+class TestNameServer:
+    def test_register_list_unregister(self, ns):
+        from hpbandster_tpu.parallel.rpc import RPCProxy
+
+        _, host, port = ns
+        proxy = RPCProxy(f"{host}:{port}")
+        assert proxy.call("ping") == "pong"
+        proxy.call("register", name="hpbandster.run_t.worker.a", uri="1.2.3.4:5")
+        proxy.call("register", name="other.service", uri="9.9.9.9:9")
+        listing = proxy.call("list", prefix="hpbandster.run_t.worker.")
+        assert listing == {"hpbandster.run_t.worker.a": "1.2.3.4:5"}
+        assert proxy.call("unregister", name="hpbandster.run_t.worker.a") is True
+        assert proxy.call("list", prefix="hpbandster.run_t.worker.") == {}
+
+    def test_credentials_file(self, tmp_path):
+        ns = NameServer(run_id="cred", working_directory=str(tmp_path))
+        host, port = ns.start()
+        w = Worker(run_id="cred")
+        w.load_nameserver_credentials(str(tmp_path))
+        assert (w.nameserver, w.nameserver_port) == (host, port)
+        ns.shutdown()
+
+
+class TestSingleWorker:
+    def test_hyperband_sequential(self, ns):
+        _, host, port = ns
+        workers = start_workers(BraninWorker, 1, "t", port)
+        opt = HyperBand(
+            configspace=branin_space(seed=0), run_id="t",
+            nameserver=host, nameserver_port=port,
+            min_budget=1, max_budget=9, eta=3, seed=0,
+        )
+        res = opt.run(n_iterations=2, min_n_workers=1)
+        opt.shutdown(shutdown_workers=True)
+        assert len(res.get_all_runs()) == 13 + 6
+        assert res.get_incumbent_id() is not None
+        # workers got the shutdown signal
+        time.sleep(0.3)
+        assert workers[0]._shutdown_event.is_set()
+
+
+class TestParallelWorkers:
+    def test_bohb_four_workers(self, ns):
+        _, host, port = ns
+        start_workers(SlowWorker, 4, "t", port)
+        opt = BOHB(
+            configspace=branin_space(seed=1), run_id="t",
+            nameserver=host, nameserver_port=port,
+            min_budget=1, max_budget=9, eta=3, seed=1, min_points_in_model=4,
+        )
+        res = opt.run(n_iterations=3, min_n_workers=4)
+        opt.shutdown(shutdown_workers=True)
+        runs = res.get_all_runs()
+        assert len(runs) == 13 + 6 + 3
+        # parallelism actually happened: distinct workers served jobs
+        names = {j.worker_name for j in opt.jobs}
+        assert len(names) >= 2
+
+    def test_elastic_join_mid_run(self, ns):
+        _, host, port = ns
+        start_workers(SlowWorker, 1, "t", port)
+        opt = HyperBand(
+            configspace=branin_space(seed=2), run_id="t",
+            nameserver=host, nameserver_port=port,
+            min_budget=1, max_budget=9, eta=3, seed=2,
+        )
+        late = []
+
+        def join_later():
+            time.sleep(0.4)
+            late.extend(start_workers(SlowWorker, 2, "t", port))
+
+        t = threading.Thread(target=join_later)
+        t.start()
+        res = opt.run(n_iterations=3, min_n_workers=1)
+        t.join()
+        opt.shutdown(shutdown_workers=True)
+        assert len(res.get_all_runs()) == 22
+        assert opt.executor.number_of_workers() >= 1
+
+
+class TestFailureHandling:
+    def test_crashed_configs_recorded_not_fatal(self, ns):
+        _, host, port = ns
+        start_workers(CrashyWorker, 2, "t", port)
+        opt = HyperBand(
+            configspace=branin_space(seed=3), run_id="t",
+            nameserver=host, nameserver_port=port,
+            min_budget=1, max_budget=9, eta=3, seed=3,
+        )
+        res = opt.run(n_iterations=2, min_n_workers=2)
+        opt.shutdown(shutdown_workers=True)
+        runs = res.get_all_runs()
+        crashed = [r for r in runs if r.loss is None]
+        ok = [r for r in runs if r.loss is not None]
+        # Branin space straddles x=0, so both kinds must exist
+        assert crashed and ok
+        assert all("intentional crash" in r.error_logs for r in crashed)
+        assert res.get_incumbent_id() is not None
+
+    def test_worker_death_requeues_job(self, ns):
+        _, host, port = ns
+        [w1] = start_workers(SlowWorker, 1, "kill", port)
+        # separate run_id so the other tests' workers don't interfere
+        opt = HyperBand(
+            configspace=branin_space(seed=4), run_id="kill",
+            nameserver=host, nameserver_port=port,
+            min_budget=1, max_budget=9, eta=3, seed=4,
+        )
+        opt.executor.ping_interval = 0.2
+
+        killed = threading.Event()
+
+        def kill_soon():
+            time.sleep(0.3)
+            # hard-kill: server vanishes without unregistering
+            w1._server.shutdown()
+            w1._server = None
+            start_workers(SlowWorker, 1, "kill", port)
+            killed.set()
+
+        t = threading.Thread(target=kill_soon)
+        t.start()
+        res = opt.run(n_iterations=1, min_n_workers=1)
+        t.join()
+        opt.shutdown(shutdown_workers=True)
+        assert killed.is_set()
+        # every one of the bracket's 13 runs completed despite the death
+        assert len(res.get_all_runs()) == 13
+        assert all(r.loss is not None for r in res.get_all_runs())
