@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Benchmark: configs evaluated per second per chip.
+
+Workload: BASELINE.json config #1 — BOHB on the 2-D Branin toy, eta=3,
+budget ladder 1..81 — run two ways on the same machine:
+
+* **batched TPU path** (this framework's north star): every stage is one
+  jitted, vmapped dispatch on the accelerator; KDE proposals are one vmapped
+  kernel per stage.
+* **reference-architecture path**: the same optimizer driven through the
+  nameserver/dispatcher/worker pool, strictly one config per worker per TCP
+  RPC round-trip — the reference's throughput ceiling
+  (``n_workers / mean_job_seconds``, BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import logging
+import time
+
+logging.getLogger().setLevel(logging.ERROR)
+logging.disable(logging.WARNING)
+
+
+def bench_batched(n_iterations: int, seed: int = 0):
+    import jax
+
+    from hpbandster_tpu.optimizers import BOHB
+    from hpbandster_tpu.parallel import BatchedExecutor, VmapBackend, config_mesh
+    from hpbandster_tpu.workloads.toys import branin_from_vector, branin_space
+
+    devices = jax.devices()
+    mesh = config_mesh(devices) if len(devices) > 1 else None
+
+    def run(n_iter, seed):
+        cs = branin_space(seed=seed)
+        # min_pad=128 folds every stage size of this ladder into one
+        # compiled eval shape
+        backend = VmapBackend(branin_from_vector, mesh=mesh, min_pad=128)
+        executor = BatchedExecutor(backend, cs)
+        opt = BOHB(
+            configspace=cs, run_id=f"bench-{seed}", executor=executor,
+            min_budget=1, max_budget=81, eta=3, seed=seed,
+        )
+        t0 = time.perf_counter()
+        opt.run(n_iterations=n_iter)
+        dt = time.perf_counter() - t0
+        opt.shutdown()
+        return executor.total_evaluated, dt
+
+    run(n_iterations, seed=99)  # warmup: populate jit caches (compile time excluded)
+    n_evals, dt = run(n_iterations, seed)
+    return n_evals, dt, len(devices)
+
+
+def bench_rpc_baseline(n_iterations: int = 1, n_workers: int = 1, seed: int = 0):
+    """Reference-architecture throughput on this host: one config per RPC."""
+    from hpbandster_tpu.core.nameserver import NameServer
+    from hpbandster_tpu.core.worker import Worker
+    from hpbandster_tpu.optimizers import BOHB
+    from hpbandster_tpu.workloads.toys import branin_dict, branin_space
+
+    class BraninWorker(Worker):
+        def compute(self, config_id, config, budget, working_directory):
+            return {"loss": branin_dict(config, budget), "info": {}}
+
+    ns = NameServer(run_id="bench-rpc", host="127.0.0.1", port=0)
+    host, port = ns.start()
+    for i in range(n_workers):
+        BraninWorker(
+            run_id="bench-rpc", nameserver=host, nameserver_port=port, id=i
+        ).run(background=True)
+    opt = BOHB(
+        configspace=branin_space(seed=seed), run_id="bench-rpc",
+        nameserver=host, nameserver_port=port,
+        min_budget=1, max_budget=81, eta=3, seed=seed,
+    )
+    t0 = time.perf_counter()
+    res = opt.run(n_iterations=n_iterations, min_n_workers=n_workers)
+    dt = time.perf_counter() - t0
+    n = len(res.get_all_runs())
+    opt.shutdown(shutdown_workers=True)
+    ns.shutdown()
+    return n, dt
+
+
+def main():
+    n_evals, dt, n_chips = bench_batched(n_iterations=5)
+    batched_cps_chip = n_evals / dt / n_chips
+
+    n_ref, dt_ref = bench_rpc_baseline(n_iterations=1, n_workers=1)
+    ref_cps = n_ref / dt_ref
+
+    print(
+        json.dumps(
+            {
+                "metric": "configs evaluated/sec/chip (BOHB, Branin, eta=3, budgets 1..81)",
+                "value": round(batched_cps_chip, 2),
+                "unit": "configs/s/chip",
+                "vs_baseline": round(batched_cps_chip / ref_cps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -81,6 +81,9 @@ class BaseIteration:
         config_sampler: Callable[[float], Tuple[Dict[str, Any], Dict[str, Any]]],
         logger: Optional[logging.Logger] = None,
         result_logger: Optional[Any] = None,
+        config_sampler_batch: Optional[
+            Callable[[float, int], List[Tuple[Dict[str, Any], Dict[str, Any]]]]
+        ] = None,
     ):
         if len(num_configs) != len(budgets):
             raise ValueError("num_configs and budgets must have equal length")
@@ -88,6 +91,9 @@ class BaseIteration:
         self.num_configs = [int(n) for n in num_configs]
         self.budgets = [float(b) for b in budgets]
         self.config_sampler = config_sampler
+        #: optional whole-stage sampler (batched executors): one vmapped
+        #: proposal kernel instead of n sequential get_config calls
+        self.config_sampler_batch = config_sampler_batch
         self.logger = logger or logging.getLogger("hpbandster_tpu")
         self.result_logger = result_logger
 
@@ -157,7 +163,15 @@ class BaseIteration:
                 self.num_running += 1
                 return (config_id, datum.config, datum.budget)
         if self.actual_num_configs[self.stage] < self.num_configs[self.stage]:
-            config_id = self.add_configuration()
+            if self.config_sampler_batch is not None:
+                # fill the whole remaining stage quota in one batched call
+                k = self.num_configs[self.stage] - self.actual_num_configs[self.stage]
+                for cfg, info in self.config_sampler_batch(
+                    self.budgets[self.stage], k
+                ):
+                    self.add_configuration(cfg, info)
+            else:
+                self.add_configuration()
             return self.get_next_run()
         return None
 

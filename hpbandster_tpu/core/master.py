@@ -179,6 +179,12 @@ class Master:
             self.time_ref = time.time()
             self.config["time_ref"] = self.time_ref
         iteration_kwargs.setdefault("result_logger", self.result_logger)
+        if getattr(self.executor, "prefers_batched_sampling", False) and hasattr(
+            self.config_generator, "get_config_batch"
+        ):
+            iteration_kwargs.setdefault(
+                "config_sampler_batch", self.config_generator.get_config_batch
+            )
 
         n_remaining = n_iterations
         while True:

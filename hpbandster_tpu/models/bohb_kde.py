@@ -33,7 +33,7 @@ from hpbandster_tpu.ops.kde import (
     KDE,
     normal_reference_bandwidths,
     propose,
-    propose_batch,
+    propose_batch_seeded,
 )
 from hpbandster_tpu.space import ConfigurationSpace
 
@@ -58,10 +58,15 @@ class BOHBKDE(base_config_generator):
         bandwidth_factor: float = 3.0,
         min_bandwidth: float = 1e-3,
         seed: Optional[int] = None,
+        proposal_batch_size: int = 128,
         **kwargs,
     ):
         super().__init__(**kwargs)
         self.configspace = configspace
+        # every stage's proposals run at this fixed batch size (sliced down
+        # to what's needed): one compiled kernel serves all bracket shapes.
+        # Extra candidates are nearly free on-device; recompiles are not.
+        self.proposal_batch_size = int(proposal_batch_size)
         self.top_n_percent = int(top_n_percent)
         self.num_samples = int(num_samples)
         self.random_fraction = float(random_fraction)
@@ -88,8 +93,11 @@ class BOHBKDE(base_config_generator):
         self.configs: Dict[float, List[np.ndarray]] = {}
         #: budget -> list of losses (inf for crashed)
         self.losses: Dict[float, List[float]] = {}
-        #: budget -> (good KDE, bad KDE)
+        #: budget -> (good KDE, bad KDE) as host (numpy) arrays
         self.kde_models: Dict[float, Tuple[KDE, KDE]] = {}
+        #: budget -> device-resident copy; invalidated on refit so each model
+        #: version uploads through the (possibly high-latency) link only once
+        self._device_kdes: Dict[float, Tuple[KDE, KDE]] = {}
 
     # -------------------------------------------------------------- plumbing
     def _next_key(self, n: int = 1):
@@ -100,6 +108,19 @@ class BOHBKDE(base_config_generator):
         if not self.kde_models:
             return None
         return max(self.kde_models.keys())
+
+    def _device_kde_pair(self, budget: float) -> Tuple[KDE, KDE]:
+        """Device-resident KDE pair for ``budget``, uploaded at most once per
+        model refit."""
+        pair = self._device_kdes.get(budget)
+        if pair is None:
+            host_good, host_bad = self.kde_models[budget]
+            pair = (
+                KDE(*(jnp.asarray(a) for a in host_good)),
+                KDE(*(jnp.asarray(a) for a in host_bad)),
+            )
+            self._device_kdes[budget] = pair
+        return pair
 
     def impute_conditional_data(self, array: np.ndarray) -> np.ndarray:
         """Replace NaN (inactive) dims: borrow the value from a random other
@@ -144,20 +165,29 @@ class BOHBKDE(base_config_generator):
             self._make_kde(good),
             self._make_kde(bad),
         )
+        self._device_kdes.pop(budget, None)
 
     def _make_kde(self, data: np.ndarray) -> KDE:
+        """Fit happens host-side in numpy (no device dispatch per result —
+        the refit runs after every single job, reference-style); the arrays
+        transfer once per *stage* when the propose kernel consumes them."""
         n, d = data.shape
-        cap = _pow2_capacity(n)
+        # generous minimum capacity: observation growth then changes the
+        # compiled shape only every doubling past 64
+        cap = _pow2_capacity(n, minimum=64)
         padded = np.zeros((cap, d), np.float32)
         padded[:n] = data
         mask = np.zeros(cap, np.float32)
         mask[:n] = 1.0
-        padded_j = jnp.asarray(padded)
-        mask_j = jnp.asarray(mask)
-        bw = normal_reference_bandwidths(
-            padded_j, mask_j, self.cards, self.min_bandwidth
+        # normal-reference rule, numpy mirror of ops.normal_reference_bandwidths
+        sigma = data.std(axis=0)
+        bw = 1.059 * sigma * n ** (-1.0 / (4.0 + d))
+        cards = np.asarray(self.cards, np.float64)
+        cap_discrete = np.where(
+            cards > 0, (np.maximum(cards, 2) - 1.0) / np.maximum(cards, 2), np.inf
         )
-        return KDE(padded_j, mask_j, bw)
+        bw = np.clip(bw, self.min_bandwidth, cap_discrete).astype(np.float32)
+        return KDE(padded, mask, bw)
 
     # ------------------------------------------------------------- interface
     def new_result(self, job: Job, update_model: bool = True) -> None:
@@ -179,7 +209,7 @@ class BOHBKDE(base_config_generator):
             cfg = self.configspace.sample_configuration(rng=self.rng)
             return dict(cfg), {"model_based_pick": False}
         try:
-            good, bad = self.kde_models[best_budget]
+            good, bad = self._device_kde_pair(best_budget)
             best_vec, _, _ = propose(
                 self._next_key(),
                 good,
@@ -215,20 +245,25 @@ class BOHBKDE(base_config_generator):
         n_model = int(use_model.sum())
         out: List[Optional[Tuple[Dict[str, Any], Dict[str, Any]]]] = [None] * n
         if n_model:
-            good, bad = self.kde_models[best_budget]
-            keys = jax.random.split(self._next_key(), n_model)
+            good, bad = self._device_kde_pair(best_budget)
+            # fixed batch size (pow2 growth above it): stage sizes vary per
+            # bracket, and every distinct batch shape would otherwise be a
+            # fresh XLA compile. Keys derive on-device from one scalar seed.
+            n_pad = _pow2_capacity(n_model, minimum=self.proposal_batch_size)
+            seed = jnp.uint32(self.rng.integers(2**32, dtype=np.uint32))
             vecs = np.asarray(
-                propose_batch(
-                    keys,
+                propose_batch_seeded(
+                    seed,
                     good,
                     bad,
                     self.vartypes,
                     self.cards,
+                    n_pad,
                     self.num_samples,
                     self.bandwidth_factor,
                     self.min_bandwidth,
                 )
-            )
+            )[:n_model]
             k = 0
             for i in range(n):
                 if use_model[i]:

@@ -16,5 +16,6 @@ from hpbandster_tpu.ops.kde import (  # noqa: F401
     normal_reference_bandwidths,
     propose,
     propose_batch,
+    propose_batch_seeded,
     sample_around,
 )

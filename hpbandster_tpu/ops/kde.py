@@ -212,6 +212,29 @@ def propose(
     return best, cands, scores
 
 
+@partial(jax.jit, static_argnames=("n", "num_samples"))
+def propose_batch_seeded(
+    seed: jax.Array,
+    good: KDE,
+    bad: KDE,
+    vartypes: jax.Array,
+    cards: jax.Array,
+    n: int,
+    num_samples: int = 64,
+    bandwidth_factor: float = 3.0,
+    min_bandwidth: float = 1e-3,
+) -> jax.Array:
+    """Like :func:`propose_batch` but derives the key batch on-device from a
+    single uint32 seed — one scalar transfer instead of an [n, 2] key upload
+    (matters when the host link is a high-latency tunnel)."""
+    keys = jax.random.split(jax.random.key(seed), n)
+    return jax.vmap(
+        lambda k: propose(
+            k, good, bad, vartypes, cards, num_samples, bandwidth_factor, min_bandwidth
+        )[0]
+    )(keys)
+
+
 @partial(jax.jit, static_argnames=("num_samples",))
 def propose_batch(
     keys: jax.Array,

@@ -30,6 +30,9 @@ class BatchedExecutor:
     #: Raise via Master.parallel_brackets to trade sample efficiency for
     #: cross-bracket batching on large meshes.
     preferred_parallel_brackets = 1
+    #: stage quotas are filled through get_config_batch (one vmapped
+    #: proposal kernel) instead of per-config get_config calls
+    prefers_batched_sampling = True
 
     def __init__(
         self,

@@ -1,0 +1,22 @@
+"""HPO workloads: jittable objectives for the batched evaluation path."""
+
+from hpbandster_tpu.workloads.toys import (  # noqa: F401
+    BRANIN_OPT,
+    HARTMANN6_OPT,
+    branin_dict,
+    branin_from_vector,
+    branin_space,
+    hartmann6_from_vector,
+    hartmann6_space,
+)
+from hpbandster_tpu.workloads.mlp import (  # noqa: F401
+    MLPConfig,
+    batched_sgd_train_step,
+    sgd_train_step_batch,
+    decode_mlp_hparams,
+    init_mlp_params,
+    make_mlp_eval_fn,
+    make_synthetic_dataset,
+    mlp_forward,
+    mlp_space,
+)

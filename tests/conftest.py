@@ -15,8 +15,15 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# The environment's sitecustomize may register an 'axon' TPU-tunnel platform
+# and force jax_platforms programmatically, which overrides the env vars
+# above — override it back: unit tests must run on the virtual 8-device CPU
+# mesh, not the single tunneled chip.
+jax.config.update("jax_platforms", "cpu")
 
 
 @pytest.fixture

@@ -1,45 +1,10 @@
-"""Toy objectives with known optima for end-to-end tests (SURVEY.md §4:
-assertions on structure/convergence-direction, not exact values)."""
+"""Toy objectives for tests — re-exported from the workloads package."""
 
-import jax.numpy as jnp
-import numpy as np
-
-from hpbandster_tpu.space import ConfigurationSpace, UniformFloatHyperparameter
-
-
-def branin_space(seed=None):
-    cs = ConfigurationSpace(seed=seed)
-    cs.add_hyperparameter(UniformFloatHyperparameter("x", -5.0, 10.0))
-    cs.add_hyperparameter(UniformFloatHyperparameter("y", 0.0, 15.0))
-    return cs
-
-
-def branin_from_vector(vec, budget):
-    """Jittable Branin on the unit-square codec; budget adds decaying noise
-    (so lower budgets are noisier, like a real fidelity ladder).
-
-    Global minimum ~0.3979 at (-pi, 12.275), (pi, 2.275), (9.425, 2.475).
-    """
-    x = vec[0] * 15.0 - 5.0
-    y = vec[1] * 15.0
-    a, b, c = 1.0, 5.1 / (4 * jnp.pi**2), 5.0 / jnp.pi
-    r, s, t = 6.0, 10.0, 1.0 / (8 * jnp.pi)
-    val = a * (y - b * x**2 + c * x - r) ** 2 + s * (1 - t) * jnp.cos(x) + s
-    # deterministic pseudo-noise shrinking with budget
-    noise = 5.0 * jnp.sin(13.7 * x + 7.3 * y) / jnp.sqrt(budget + 1e-9)
-    return val + noise
-
-
-def branin_dict(config, budget):
-    """Host-side Branin for Worker.compute-style tests."""
-    x, y = config["x"], config["y"]
-    val = (
-        (y - 5.1 / (4 * np.pi**2) * x**2 + 5.0 / np.pi * x - 6.0) ** 2
-        + 10 * (1 - 1 / (8 * np.pi)) * np.cos(x)
-        + 10
-    )
-    noise = 5.0 * np.sin(13.7 * x + 7.3 * y) / np.sqrt(budget + 1e-9)
-    return float(val + noise)
-
-
-BRANIN_OPT = 0.397887
+from hpbandster_tpu.workloads.toys import (  # noqa: F401
+    BRANIN_OPT,
+    branin_dict,
+    branin_from_vector,
+    branin_space,
+    hartmann6_from_vector,
+    hartmann6_space,
+)
