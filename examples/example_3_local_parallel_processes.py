@@ -1,0 +1,67 @@
+"""Example 3: local parallel run — workers as separate OS processes.
+
+Reference ladder rung 3: the same script doubles as master and worker;
+run with ``--worker`` to start a worker process. The master spawns the
+workers itself here for convenience, but the pattern is exactly what a
+cluster job array does (see example 4).
+"""
+
+import argparse
+import subprocess
+import sys
+
+from hpbandster_tpu import BOHB, NameServer
+
+from example_1_local_sequential import MyWorker, get_configspace
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--worker", action="store_true", help="run as a worker process")
+    p.add_argument("--nameserver", type=str, default="127.0.0.1")
+    p.add_argument("--nameserver_port", type=int, default=0)
+    p.add_argument("--n_workers", type=int, default=3)
+    p.add_argument("--n_iterations", type=int, default=4)
+    args = p.parse_args()
+
+    if args.worker:
+        w = MyWorker(
+            run_id="example3",
+            nameserver=args.nameserver,
+            nameserver_port=args.nameserver_port,
+            timeout=30,  # self-shutdown when idle
+        )
+        w.run(background=False)  # blocks, serving jobs
+        return
+
+    ns = NameServer(run_id="example3", host="127.0.0.1", port=0)
+    host, port = ns.start()
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, __file__, "--worker",
+             "--nameserver", host, "--nameserver_port", str(port)]
+        )
+        for _ in range(args.n_workers)
+    ]
+
+    bohb = BOHB(
+        configspace=get_configspace(),
+        run_id="example3",
+        nameserver=host,
+        nameserver_port=port,
+        min_budget=1,
+        max_budget=9,
+    )
+    res = bohb.run(n_iterations=args.n_iterations, min_n_workers=args.n_workers)
+    bohb.shutdown(shutdown_workers=True)
+    ns.shutdown()
+    for proc in procs:
+        proc.wait(timeout=10)
+
+    incumbent = res.get_incumbent_id()
+    print(f"best: {res.get_id2config_mapping()[incumbent]['config']}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Example 5: a real training workload — MLP hyperparameter search.
+
+Reference ladder rung 5 (the PyTorch/Keras MNIST worker): here the worker
+trains a JAX MLP on a classification task, with budget = number of SGD
+steps. This is the *host-pool* version — each worker process trains one
+config at a time, exactly like the reference. Compare example 7, where the
+same workload runs as one batched computation on the accelerator.
+"""
+
+import argparse
+
+from hpbandster_tpu import BOHB, NameServer, Worker
+from hpbandster_tpu.workloads.mlp import (
+    MLPConfig,
+    make_mlp_eval_fn,
+    mlp_space,
+)
+
+
+class MLPWorker(Worker):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.eval_fn = make_mlp_eval_fn(MLPConfig())
+        self.space = mlp_space()
+
+    def compute(self, config_id, config, budget, working_directory):
+        vec = self.space.to_vector(config)
+        loss = float(self.eval_fn(vec.astype("float32"), float(budget)))
+        return {"loss": loss, "info": {"steps": int(budget)}}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n_workers", type=int, default=2)
+    p.add_argument("--n_iterations", type=int, default=3)
+    p.add_argument("--min_budget", type=float, default=10)
+    p.add_argument("--max_budget", type=float, default=270)
+    args = p.parse_args()
+
+    ns = NameServer(run_id="example5", host="127.0.0.1", port=0)
+    host, port = ns.start()
+    for i in range(args.n_workers):
+        MLPWorker(
+            run_id="example5", nameserver=host, nameserver_port=port, id=i
+        ).run(background=True)
+
+    bohb = BOHB(
+        configspace=mlp_space(),
+        run_id="example5",
+        nameserver=host,
+        nameserver_port=port,
+        min_budget=args.min_budget,
+        max_budget=args.max_budget,
+        eta=3,
+    )
+    res = bohb.run(n_iterations=args.n_iterations, min_n_workers=args.n_workers)
+    bohb.shutdown(shutdown_workers=True)
+    ns.shutdown()
+
+    inc = res.get_incumbent_id()
+    runs = res.get_runs_by_id(inc)
+    print(f"best config: {res.get_id2config_mapping()[inc]['config']}")
+    print(f"val loss at max budget: {runs[-1].loss:.4f}")
+
+
+if __name__ == "__main__":
+    main()

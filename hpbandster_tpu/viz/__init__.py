@@ -1,0 +1,10 @@
+"""Analysis / visualization (reference: ``hpbandster/visualization.py``)."""
+
+from hpbandster_tpu.viz.plots import (  # noqa: F401
+    concurrent_runs_over_time,
+    correlation_across_budgets,
+    default_tool_tips,
+    finished_runs_over_time,
+    interactive_HBS_plot,
+    losses_over_time,
+)

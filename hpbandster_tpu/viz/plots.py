@@ -1,0 +1,251 @@
+"""Matplotlib analysis plots over Result data.
+
+Function-for-function port of the reference's visualization surface
+(SURVEY.md §2 "visualization" row): losses-over-time per budget,
+concurrent/finished-runs-over-time, loss-rank correlation across budgets,
+and the interactive hover plot for config inspection. Matplotlib import is
+deferred so headless installations can use everything else.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "default_tool_tips",
+    "losses_over_time",
+    "concurrent_runs_over_time",
+    "finished_runs_over_time",
+    "correlation_across_budgets",
+    "interactive_HBS_plot",
+]
+
+
+def _require_plt():
+    try:
+        import matplotlib.pyplot as plt
+
+        return plt
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "matplotlib is required for hpbandster_tpu.viz plots"
+        ) from e
+
+
+def default_tool_tips(result, learning_curves: Optional[Dict] = None) -> Dict:
+    """Per-config hover strings: id, config values, losses per budget."""
+    id2conf = result.get_id2config_mapping()
+    tips = {}
+    for cid, conf in id2conf.items():
+        runs = result.get_runs_by_id(cid)
+        lines = [str(cid)]
+        lines += [f"{k}: {v}" for k, v in sorted(conf["config"].items())]
+        lines += [
+            f"budget {r.budget:g}: loss {r.loss}" for r in runs
+        ]
+        if conf["config_info"]:
+            lines += [f"{k}: {v}" for k, v in sorted(conf["config_info"].items())]
+        tips[cid] = "\n".join(lines)
+    return tips
+
+
+def losses_over_time(
+    runs: List,
+    get_loss_from_run_fn: Callable = lambda r: r.loss,
+    cmap=None,
+    show: bool = False,
+):
+    """Scatter of losses vs finish time, one color per budget."""
+    plt = _require_plt()
+    cmap = cmap or plt.get_cmap("tab10")
+
+    budgets = sorted({r.budget for r in runs})
+    data = {b: [] for b in budgets}
+    t0 = min(r.time_stamps.get("finished", 0.0) for r in runs) if runs else 0.0
+    for r in runs:
+        loss = get_loss_from_run_fn(r)
+        if loss is None:
+            continue
+        data[r.budget].append((r.time_stamps.get("finished", 0.0) - t0, loss))
+
+    fig, ax = plt.subplots()
+    for i, b in enumerate(budgets):
+        if not data[b]:
+            continue
+        arr = np.array(data[b])
+        ax.scatter(arr[:, 0], arr[:, 1], color=cmap(i % 10), label=f"budget {b:g}")
+    ax.set_xlabel("wall clock time [s]")
+    ax.set_ylabel("loss")
+    ax.legend()
+    if show:  # pragma: no cover
+        plt.show()
+    return fig, ax
+
+
+def _events(runs) -> Tuple[np.ndarray, np.ndarray]:
+    """(times, deltas) of run start/finish events, time-sorted."""
+    ev = []
+    for r in runs:
+        ts = r.time_stamps
+        if "started" in ts:
+            ev.append((ts["started"], +1))
+        if "finished" in ts:
+            ev.append((ts["finished"], -1))
+    ev.sort()
+    if not ev:
+        return np.zeros(0), np.zeros(0)
+    t = np.array([e[0] for e in ev])
+    d = np.array([e[1] for e in ev])
+    return t - t[0], d
+
+
+def concurrent_runs_over_time(runs: List, show: bool = False):
+    """Step plot of how many runs execute simultaneously."""
+    plt = _require_plt()
+    t, d = _events(runs)
+    fig, ax = plt.subplots()
+    ax.step(t, np.cumsum(d), where="post")
+    ax.set_xlabel("wall clock time [s]")
+    ax.set_ylabel("concurrent runs")
+    if show:  # pragma: no cover
+        plt.show()
+    return fig, ax
+
+
+def finished_runs_over_time(runs: List, show: bool = False):
+    """Cumulative finished-run count per budget over time."""
+    plt = _require_plt()
+    fig, ax = plt.subplots()
+    budgets = sorted({r.budget for r in runs})
+    t0 = min(
+        (r.time_stamps.get("finished", 0.0) for r in runs), default=0.0
+    )
+    for b in budgets:
+        times = sorted(
+            r.time_stamps.get("finished", 0.0) - t0
+            for r in runs
+            if r.budget == b
+        )
+        ax.step(times, np.arange(1, len(times) + 1), where="post", label=f"budget {b:g}")
+    ax.set_xlabel("wall clock time [s]")
+    ax.set_ylabel("finished runs")
+    ax.legend()
+    if show:  # pragma: no cover
+        plt.show()
+    return fig, ax
+
+
+def correlation_across_budgets(result, show: bool = False):
+    """Spearman rank correlation of losses between every budget pair —
+    the diagnostic for whether low fidelities predict high ones."""
+    plt = _require_plt()
+    runs = result.get_all_runs()
+    budgets = sorted({r.budget for r in runs})
+    loss_by_cfg: Dict = {}
+    for r in runs:
+        if r.loss is not None:
+            loss_by_cfg.setdefault(r.config_id, {})[r.budget] = r.loss
+
+    def spearman(x: np.ndarray, y: np.ndarray) -> float:
+        rx = np.argsort(np.argsort(x)).astype(float)
+        ry = np.argsort(np.argsort(y)).astype(float)
+        if rx.std() == 0 or ry.std() == 0:
+            return np.nan
+        return float(np.corrcoef(rx, ry)[0, 1])
+
+    n = len(budgets)
+    corr = np.full((n, n), np.nan)
+    counts = np.zeros((n, n), dtype=int)
+    for i, b1 in enumerate(budgets):
+        for j, b2 in enumerate(budgets):
+            pairs = [
+                (v[b1], v[b2])
+                for v in loss_by_cfg.values()
+                if b1 in v and b2 in v
+            ]
+            counts[i, j] = len(pairs)
+            if len(pairs) >= 3:
+                arr = np.array(pairs)
+                corr[i, j] = spearman(arr[:, 0], arr[:, 1])
+
+    fig, ax = plt.subplots()
+    im = ax.imshow(corr, vmin=-1, vmax=1, cmap="RdBu")
+    ax.set_xticks(range(n), [f"{b:g}" for b in budgets])
+    ax.set_yticks(range(n), [f"{b:g}" for b in budgets])
+    ax.set_xlabel("budget")
+    ax.set_ylabel("budget")
+    fig.colorbar(im, ax=ax, label="Spearman rank correlation")
+    for i in range(n):
+        for j in range(n):
+            if np.isfinite(corr[i, j]):
+                ax.text(j, i, f"{corr[i,j]:.2f}\n(n={counts[i,j]})",
+                        ha="center", va="center", fontsize=8)
+    if show:  # pragma: no cover
+        plt.show()
+    return fig, ax, corr
+
+
+def interactive_HBS_plot(
+    learning_curves: Dict,
+    tool_tip_strings: Optional[Dict] = None,
+    log_y: bool = False,
+    log_x: bool = False,
+    reset_times: bool = False,
+    color_map: str = "tab10",
+    colors_floats: Optional[Dict] = None,
+    title: str = "",
+    show: bool = False,
+):
+    """Learning curves (loss vs budget) with hover tool-tips per config.
+
+    ``learning_curves`` is the dict from ``Result.get_learning_curves()``.
+    """
+    plt = _require_plt()
+    cmap = plt.get_cmap(color_map)
+    fig, ax = plt.subplots()
+    artists = {}
+    for i, (cid, curves) in enumerate(sorted(learning_curves.items())):
+        for curve in curves:
+            if not curve:
+                continue
+            xs = [p[0] for p in curve]
+            ys = [p[1] for p in curve]
+            (ln,) = ax.plot(
+                xs, ys, marker="o", alpha=0.6,
+                color=cmap(i % 10) if colors_floats is None
+                else cmap(colors_floats.get(cid, 0.0)),
+                picker=5,
+            )
+            artists[ln] = cid
+    if log_y:
+        ax.set_yscale("log")
+    if log_x:
+        ax.set_xscale("log")
+    ax.set_xlabel("budget")
+    ax.set_ylabel("loss")
+    ax.set_title(title)
+
+    if tool_tip_strings is not None:
+        annot = ax.annotate(
+            "", xy=(0, 0), xytext=(10, 10), textcoords="offset points",
+            bbox={"boxstyle": "round", "fc": "w"}, fontsize=8,
+        )
+        annot.set_visible(False)
+
+        def on_pick(event):  # pragma: no cover - needs a GUI backend
+            cid = artists.get(event.artist)
+            if cid is None:
+                return
+            x = event.artist.get_xdata()[event.ind[0]]
+            y = event.artist.get_ydata()[event.ind[0]]
+            annot.xy = (x, y)
+            annot.set_text(tool_tip_strings.get(cid, str(cid)))
+            annot.set_visible(True)
+            fig.canvas.draw_idle()
+
+        fig.canvas.mpl_connect("pick_event", on_pick)
+    if show:  # pragma: no cover
+        plt.show()
+    return fig, ax

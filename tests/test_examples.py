@@ -1,0 +1,73 @@
+"""The examples ladder doubles as integration tests (reference practice,
+SURVEY.md §4) — run each example script end-to-end."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def run_example(name, *args, timeout=240):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (
+        os.path.abspath(os.path.join(EXAMPLES, ""))
+        + os.pathsep
+        + os.path.abspath(os.path.join(EXAMPLES, ".."))
+        + os.pathsep
+        + env.get("PYTHONPATH", "")
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, name), *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=EXAMPLES,
+    )
+    assert proc.returncode == 0, f"{name} failed:\n{proc.stdout}\n{proc.stderr}"
+    return proc.stdout
+
+
+def test_example_1_sequential():
+    out = run_example("example_1_local_sequential.py", "--n_iterations", "2")
+    assert "best found configuration" in out
+
+
+def test_example_2_threads():
+    out = run_example(
+        "example_2_local_parallel_threads.py", "--n_workers", "3",
+        "--n_iterations", "2",
+    )
+    assert "best:" in out
+
+
+def test_example_3_processes():
+    out = run_example(
+        "example_3_local_parallel_processes.py", "--n_workers", "2",
+        "--n_iterations", "2",
+    )
+    assert "best:" in out
+
+
+def test_example_5_mlp_worker():
+    out = run_example(
+        "example_5_mlp_worker.py", "--n_workers", "1", "--n_iterations", "1",
+        "--min_budget", "5", "--max_budget", "45",
+    )
+    assert "val loss at max budget" in out
+
+
+def test_example_6_analysis_warmstart(tmp_path):
+    out = run_example(
+        "example_6_analysis_warmstart.py", "--out_dir", str(tmp_path), "--plot",
+    )
+    assert "phase 3 final incumbent loss" in out
+    assert (tmp_path / "losses_over_time.png").exists()
+
+
+def test_example_7_tpu_batched():
+    out = run_example(
+        "example_7_tpu_batched.py", "--n_iterations", "2",
+        "--min_budget", "5", "--max_budget", "45",
+    )
+    assert "configs/s" in out

@@ -1,0 +1,63 @@
+"""Smoke tests for the visualization surface (headless Agg backend)."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+from hpbandster_tpu.optimizers import HyperBand
+from hpbandster_tpu.parallel import BatchedExecutor, VmapBackend
+from hpbandster_tpu.viz import (
+    concurrent_runs_over_time,
+    correlation_across_budgets,
+    default_tool_tips,
+    finished_runs_over_time,
+    interactive_HBS_plot,
+    losses_over_time,
+)
+
+from tests.toys import branin_from_vector, branin_space
+
+
+@pytest.fixture(scope="module")
+def result():
+    cs = branin_space(seed=0)
+    executor = BatchedExecutor(VmapBackend(branin_from_vector), cs)
+    opt = HyperBand(
+        configspace=cs, run_id="viz", executor=executor,
+        min_budget=1, max_budget=9, eta=3, seed=0,
+    )
+    res = opt.run(n_iterations=3)
+    opt.shutdown()
+    return res
+
+
+def test_losses_over_time(result):
+    fig, ax = losses_over_time(result.get_all_runs())
+    assert len(ax.collections) >= 2  # one scatter per budget
+
+
+def test_concurrent_and_finished(result):
+    fig, ax = concurrent_runs_over_time(result.get_all_runs())
+    assert ax.lines
+    fig, ax = finished_runs_over_time(result.get_all_runs())
+    assert ax.lines
+
+
+def test_correlation_across_budgets(result):
+    fig, ax, corr = correlation_across_budgets(result)
+    assert corr.shape == (3, 3)
+    # diagonal is perfect self-correlation wherever defined
+    for i in range(3):
+        if np.isfinite(corr[i, i]):
+            assert corr[i, i] == pytest.approx(1.0)
+
+
+def test_interactive_plot_and_tooltips(result):
+    lcs = result.get_learning_curves()
+    tips = default_tool_tips(result)
+    assert set(tips) == set(result.get_id2config_mapping())
+    fig, ax = interactive_HBS_plot(lcs, tool_tip_strings=tips)
+    assert ax.lines
