@@ -1,0 +1,130 @@
+"""Mid-run optimizer-state checkpointing.
+
+The reference only checkpoints at the *results* level (JSONL streaming +
+warm-start; SURVEY.md §5 "Checkpoint / resume": "No mid-bracket resume of
+the Master's internal state"). This module adds that missing capability:
+the full Master state — every bracket's Datum bookkeeping, stage pointers,
+and the config generator's observations/RNG — serializes to one file, and a
+freshly-constructed optimizer resumes exactly where the run stopped.
+In-flight (RUNNING) configs are rolled back to QUEUED so their evaluations
+re-run after restore.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict
+
+from hpbandster_tpu.core.iteration import Datum, Status
+
+__all__ = ["master_state_dict", "restore_master_state", "save_checkpoint", "load_checkpoint"]
+
+_FORMAT_VERSION = 1
+
+
+def _datum_state(d: Datum) -> Dict[str, Any]:
+    status = d.status
+    if status == Status.RUNNING:  # re-run interrupted evaluations on resume
+        status = Status.QUEUED
+    return {
+        "config": d.config,
+        "config_info": d.config_info,
+        "results": d.results,
+        "time_stamps": d.time_stamps,
+        "exceptions": d.exceptions,
+        "status": int(status),
+        "budget": d.budget,
+    }
+
+
+def master_state_dict(master) -> Dict[str, Any]:
+    """Snapshot a Master (under its own lock) into a picklable dict."""
+    with master.thread_cond:
+        iterations = []
+        for it in master.iterations:
+            iterations.append(
+                {
+                    "HPB_iter": it.HPB_iter,
+                    "num_configs": list(it.num_configs),
+                    "budgets": list(it.budgets),
+                    "stage": it.stage,
+                    "actual_num_configs": list(it.actual_num_configs),
+                    "is_finished": it.is_finished,
+                    "data": {cid: _datum_state(d) for cid, d in it.data.items()},
+                }
+            )
+        state = {
+            "format_version": _FORMAT_VERSION,
+            "config": dict(master.config),
+            "time_ref": master.time_ref,
+            "iterations": iterations,
+        }
+        if hasattr(master.config_generator, "get_state"):
+            state["config_generator"] = master.config_generator.get_state()
+    return state
+
+
+def restore_master_state(master, state: Dict[str, Any]) -> None:
+    """Rehydrate a freshly-constructed Master from :func:`master_state_dict`.
+
+    The master must have been built with the same bracket arithmetic
+    (eta / budgets) — iteration shapes are re-derived via
+    ``get_next_iteration`` and verified against the snapshot.
+    """
+    if state.get("format_version") != _FORMAT_VERSION:
+        raise ValueError(f"unsupported checkpoint version {state.get('format_version')}")
+    with master.thread_cond:
+        if master.iterations:
+            raise RuntimeError("can only restore into a fresh Master")
+        master.config.update(state["config"])
+        master.time_ref = state["time_ref"]
+        if "config_generator" in state and hasattr(
+            master.config_generator, "set_state"
+        ):
+            master.config_generator.set_state(state["config_generator"])
+        for it_state in state["iterations"]:
+            it = master.get_next_iteration(
+                it_state["HPB_iter"], {"result_logger": master.result_logger}
+            )
+            if list(it.num_configs) != it_state["num_configs"] or [
+                float(b) for b in it.budgets
+            ] != it_state["budgets"]:
+                raise ValueError(
+                    f"iteration {it_state['HPB_iter']} shape mismatch: checkpoint "
+                    f"{it_state['num_configs']}@{it_state['budgets']} vs "
+                    f"{list(it.num_configs)}@{list(it.budgets)} — was the "
+                    "optimizer constructed with different eta/budget settings?"
+                )
+            it.stage = it_state["stage"]
+            it.actual_num_configs = list(it_state["actual_num_configs"])
+            it.is_finished = it_state["is_finished"]
+            it.num_running = 0
+            it.data = {
+                tuple(cid): Datum(
+                    config=ds["config"],
+                    config_info=ds["config_info"],
+                    results=ds["results"],
+                    time_stamps=ds["time_stamps"],
+                    exceptions=ds["exceptions"],
+                    status=Status(ds["status"]),
+                    budget=ds["budget"],
+                )
+                for cid, ds in it_state["data"].items()
+            }
+            master.iterations.append(it)
+
+
+def save_checkpoint(master, path: str) -> None:
+    state = master_state_dict(master)
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as fh:
+        pickle.dump(state, fh)
+    import os
+
+    os.replace(tmp, path)  # atomic: a crash mid-write never corrupts
+
+
+def load_checkpoint(master, path: str) -> None:
+    with open(path, "rb") as fh:
+        state = pickle.load(fh)
+    restore_master_state(master, state)

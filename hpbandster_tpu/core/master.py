@@ -49,6 +49,8 @@ class Master:
         host: Optional[str] = None,
         ping_interval: float = 60.0,
         shutdown_workers: bool = True,
+        checkpoint_path: Optional[str] = None,
+        checkpoint_interval: float = 30.0,
     ):
         self.run_id = run_id
         self.config_generator = config_generator
@@ -66,6 +68,13 @@ class Master:
 
         self.time_ref: Optional[float] = None
         self.config: Dict[str, Any] = {"time_ref": None}
+
+        # optional mid-run state checkpointing (capability the reference
+        # lacks — see core/checkpoint.py); auto-saves at most every
+        # checkpoint_interval seconds from job_callback
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_interval = float(checkpoint_interval)
+        self._last_checkpoint = 0.0
 
         # re-entrant: batched executors fire job_callback synchronously from
         # inside flush(), which runs under this same condition
@@ -138,6 +147,11 @@ class Master:
             self.iterations[job.id[0]].process_results()
             if self.num_running_jobs <= self.job_queue_sizes[0]:
                 self.thread_cond.notify_all()
+            if (
+                self.checkpoint_path is not None
+                and time.time() - self._last_checkpoint > self.checkpoint_interval
+            ):
+                self.save_checkpoint(self.checkpoint_path)
 
     def _submit_job(self, config_id: ConfigId, config: Dict[str, Any], budget: float) -> None:
         job = Job(
@@ -186,7 +200,10 @@ class Master:
                 "config_sampler_batch", self.config_generator.get_config_batch
             )
 
-        n_remaining = n_iterations
+        # resumed masters already hold restored iterations: n_iterations is
+        # the TOTAL bracket count, matching the semantics of re-running the
+        # original call after a crash
+        n_remaining = max(n_iterations - len(self.iterations), 0)
         while True:
             with self.thread_cond:
                 # respect the in-flight window (async executors)
@@ -240,3 +257,20 @@ class Master:
     def shutdown(self, shutdown_workers: bool = False) -> None:
         self.logger.debug("master shutdown (workers=%s)", shutdown_workers)
         self.executor.shutdown(shutdown_workers)
+
+    # ------------------------------------------------------------ checkpoint
+    def save_checkpoint(self, path: str) -> None:
+        """Snapshot full optimizer state (brackets + model) to ``path``."""
+        from hpbandster_tpu.core.checkpoint import save_checkpoint
+
+        save_checkpoint(self, path)
+        self._last_checkpoint = time.time()
+        self.logger.debug("checkpoint written to %s", path)
+
+    def load_checkpoint(self, path: str) -> None:
+        """Restore state saved by :meth:`save_checkpoint` into this (fresh)
+        optimizer; a subsequent ``run(n_iterations=<same total>)`` resumes
+        mid-bracket."""
+        from hpbandster_tpu.core.checkpoint import load_checkpoint
+
+        load_checkpoint(self, path)

@@ -189,6 +189,29 @@ class BOHBKDE(base_config_generator):
         bw = np.clip(bw, self.min_bandwidth, cap_discrete).astype(np.float32)
         return KDE(padded, mask, bw)
 
+    # ----------------------------------------------------------- checkpoint
+    def get_state(self) -> Dict[str, Any]:
+        """Picklable snapshot: observations + RNG; KDEs refit on restore."""
+        return {
+            "configs": {b: [np.asarray(v) for v in vs] for b, vs in self.configs.items()},
+            "losses": {b: list(ls) for b, ls in self.losses.items()},
+            "np_rng": self.rng.bit_generator.state,
+            "jax_key": np.asarray(jax.random.key_data(self.key)),
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.configs = {
+            float(b): [np.asarray(v) for v in vs] for b, vs in state["configs"].items()
+        }
+        self.losses = {float(b): list(ls) for b, ls in state["losses"].items()}
+        self.rng = np.random.default_rng()
+        self.rng.bit_generator.state = state["np_rng"]
+        self.key = jax.random.wrap_key_data(jnp.asarray(state["jax_key"]))
+        self.kde_models.clear()
+        self._device_kdes.clear()
+        for budget in self.configs:
+            self._fit_kde_pair(budget)
+
     # ------------------------------------------------------------- interface
     def new_result(self, job: Job, update_model: bool = True) -> None:
         super().new_result(job, update_model=update_model)

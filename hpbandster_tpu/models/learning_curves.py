@@ -1,0 +1,73 @@
+"""Learning-curve models for budget-extrapolation optimizers.
+
+Reference counterpart: ``hpbandster/learning_curve_models/`` backing the
+experimental H2BO optimizer (SURVEY.md §2, tagged [LOW] — the exact upstream
+API is unverified, so this module keeps a minimal, documented surface: fit
+per-config (budget, loss) curves, predict loss at a target budget).
+
+Models are small closed-form fits (last-value carry-forward and a power-law
+``loss ≈ a * budget^(-b) + c``), vectorized with numpy — curve counts are
+small and fits run host-side between stages.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["LastValueModel", "PowerLawModel"]
+
+Curve = Sequence[Tuple[float, float]]  # [(budget, loss), ...]
+
+
+class LastValueModel:
+    """Predicts the most recent observation — the no-extrapolation baseline."""
+
+    def fit(self, curves: List[Curve]) -> "LastValueModel":
+        return self
+
+    def predict(self, curve: Curve, target_budget: float) -> float:
+        if not curve:
+            return float("nan")
+        return float(sorted(curve)[-1][1])
+
+
+class PowerLawModel:
+    """Per-curve power-law extrapolation ``loss(b) ≈ a * b^(-k) + c``.
+
+    Fit by log-linear regression on differences from the running minimum;
+    degenerate curves (fewer than 3 points, non-decreasing) fall back to
+    last-value.
+    """
+
+    def __init__(self, floor: float = 1e-12):
+        self.floor = floor
+
+    def fit(self, curves: List[Curve]) -> "PowerLawModel":
+        return self
+
+    def predict(self, curve: Curve, target_budget: float) -> float:
+        pts = sorted(curve)
+        if len(pts) < 3:
+            return LastValueModel().predict(curve, target_budget)
+        b = np.array([p[0] for p in pts], dtype=np.float64)
+        y = np.array([p[1] for p in pts], dtype=np.float64)
+        # asymptote estimate from the last three points: on a geometric
+        # budget ladder the residuals (y - c) of a power law form a geometric
+        # sequence, so c = (y0*y2 - y1^2) / (y0 + y2 - 2*y1) exactly
+        y0, y1, y2 = y[-3], y[-2], y[-1]
+        denom = y0 + y2 - 2 * y1
+        c_est = (y0 * y2 - y1 * y1) / denom if abs(denom) > 1e-12 else -np.inf
+        c = min(c_est, y.min() - self.floor) if np.isfinite(c_est) else y.min() - self.floor
+        resid = y - c
+        if (resid <= 0).any() or (np.diff(y) > 0).all():
+            return LastValueModel().predict(curve, target_budget)
+        try:
+            slope, intercept = np.polyfit(np.log(b), np.log(resid), 1)
+        except (np.linalg.LinAlgError, ValueError):
+            return LastValueModel().predict(curve, target_budget)
+        if slope > 0:  # diverging fit — don't trust it
+            return LastValueModel().predict(curve, target_budget)
+        pred = c + np.exp(intercept + slope * np.log(target_budget))
+        return float(pred)

@@ -38,3 +38,11 @@ class RandomSampling(base_config_generator):
             (dict(c), {"model_based_pick": False})
             for c in self.configspace.sample_configuration(n, rng=self.rng)
         ]
+
+    # ----------------------------------------------------------- checkpoint
+    def get_state(self):
+        return {"np_rng": self.rng.bit_generator.state}
+
+    def set_state(self, state):
+        self.rng = np.random.default_rng()
+        self.rng.bit_generator.state = state["np_rng"]

