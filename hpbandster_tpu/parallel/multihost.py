@@ -1,0 +1,77 @@
+"""Multi-host (DCN-tier) support.
+
+SURVEY.md §2 "Distributed communication backend" prescribes two tiers for
+the rebuild: the ICI tier (sharded batched evaluation inside one jit — see
+``backends.py``) and a DCN tier for multi-host pods. This module wires the
+DCN tier the JAX-native way:
+
+* :func:`initialize_multihost` — ``jax.distributed.initialize`` bootstrap;
+  after it, ``jax.devices()`` spans the pod and a ``Mesh`` built from them
+  makes the same ``VmapBackend`` code scale across hosts (XLA routes
+  collectives over ICI within a slice and DCN between slices).
+* :class:`MultiHostBatchedExecutor` — SPMD driver pattern: every host runs
+  the same Master loop deterministically (same seeds), each jitted wave is
+  a global computation over the pod-wide mesh, and only process 0 talks to
+  result loggers — so there is no extra coordination protocol beyond XLA's.
+
+The *elastic* worker pool (dynamic join/leave) intentionally stays on the
+host RPC tier (``dispatcher.py``): JAX's SPMD model requires static mesh
+membership per run (SURVEY.md §7 "Multi-host elasticity").
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from hpbandster_tpu.parallel.batched_executor import BatchedExecutor
+
+logger = logging.getLogger("hpbandster_tpu.multihost")
+
+__all__ = ["initialize_multihost", "MultiHostBatchedExecutor", "is_primary_host"]
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Join the pod; returns this process's id. Safe to call when already
+    initialized or in single-process mode (returns 0)."""
+    import jax
+
+    if num_processes is None or num_processes <= 1:
+        return 0
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:  # already initialized
+        logger.debug("jax.distributed.initialize: %s", e)
+    return jax.process_index()
+
+
+def is_primary_host() -> bool:
+    import jax
+
+    return jax.process_index() == 0
+
+
+class MultiHostBatchedExecutor(BatchedExecutor):
+    """BatchedExecutor for SPMD multi-host runs.
+
+    Every host must construct the identical optimizer (same seeds/settings)
+    and call ``run()`` — the Master's control flow is deterministic, so all
+    hosts issue the same global computations in the same order. Side effects
+    (result logging, checkpointing) fire only on process 0.
+    """
+
+    def __init__(self, backend, configspace, **kwargs):
+        super().__init__(backend, configspace, **kwargs)
+        import jax
+
+        #: use this to gate side effects (result_logger, checkpoints):
+        #: pass them to the Master only when primary is True
+        self.primary = jax.process_index() == 0

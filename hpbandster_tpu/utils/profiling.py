@@ -1,0 +1,55 @@
+"""Tracing / profiling hooks.
+
+The reference's only tracing is per-job wall-clock timestamps (SURVEY.md §5
+"Tracing / profiling" row) — those are preserved verbatim on Job/Datum. This
+module adds what the survey's rebuild note asks for: ``jax.profiler`` trace
+capture around the batched device path, so the on-device stages show up in
+TensorBoard/Perfetto with named annotations.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+from typing import Iterator, Optional
+
+logger = logging.getLogger("hpbandster_tpu.profiling")
+
+__all__ = ["trace", "annotate", "attach_profiler"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture a jax.profiler trace into ``log_dir`` (no-op when None)."""
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def annotate(name: str):
+    """Named region inside a trace (jax.profiler.TraceAnnotation)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def attach_profiler(executor, log_dir: str) -> None:
+    """Wrap a BatchedExecutor's flush so every device wave is captured.
+
+    Usage::
+
+        executor = BatchedExecutor(backend, cs)
+        attach_profiler(executor, "/tmp/hpb_trace")
+    """
+    original_flush = executor.flush
+
+    def profiled_flush():
+        with trace(log_dir):
+            return original_flush()
+
+    executor.flush = profiled_flush
+    logger.info("profiler attached; traces -> %s", log_dir)

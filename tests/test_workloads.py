@@ -1,0 +1,99 @@
+"""Tests for the workloads package (toys + batched MLP training)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hpbandster_tpu.workloads import (
+    BRANIN_OPT,
+    HARTMANN6_OPT,
+    MLPConfig,
+    branin_dict,
+    branin_from_vector,
+    branin_space,
+    hartmann6_from_vector,
+    make_mlp_eval_fn,
+    mlp_space,
+)
+
+
+class TestToys:
+    def test_branin_vector_matches_dict(self):
+        cs = branin_space(seed=0)
+        for cfg in cs.sample_configuration(10):
+            vec = jnp.asarray(cs.to_vector(cfg), jnp.float32)
+            v1 = float(branin_from_vector(vec, 81.0))
+            v2 = branin_dict(cfg, 81.0)
+            assert v1 == pytest.approx(v2, rel=1e-4)
+
+    def test_branin_optimum(self):
+        # (pi, 2.275) -> unit coords
+        vec = jnp.asarray([(np.pi + 5) / 15, 2.275 / 15], jnp.float32)
+        val = float(branin_from_vector(vec, 1e12))  # huge budget: no noise
+        assert val == pytest.approx(BRANIN_OPT, abs=1e-3)
+
+    def test_hartmann6_optimum(self):
+        x_star = jnp.asarray(
+            [0.20169, 0.150011, 0.476874, 0.275332, 0.311652, 0.6573],
+            jnp.float32,
+        )
+        val = float(hartmann6_from_vector(x_star, 1e12))
+        assert val == pytest.approx(HARTMANN6_OPT, abs=1e-3)
+
+    def test_noise_decays_with_budget(self):
+        vec = jnp.asarray([0.3, 0.7], jnp.float32)
+        lo = abs(float(branin_from_vector(vec, 1.0)) - float(branin_from_vector(vec, 1e12)))
+        hi = abs(float(branin_from_vector(vec, 81.0)) - float(branin_from_vector(vec, 1e12)))
+        assert hi < lo
+
+
+class TestMLPWorkload:
+    @pytest.fixture(scope="class")
+    def eval_fn(self):
+        return make_mlp_eval_fn(MLPConfig(n_train=256, n_val=128))
+
+    def test_training_reduces_loss(self, eval_fn):
+        cs = mlp_space(seed=0)
+        cfg = {"lr": 0.1, "momentum": 0.9, "weight_decay": 1e-6, "init_scale": 1.0}
+        vec = jnp.asarray(cs.to_vector(cfg), jnp.float32)
+        loss_0 = float(eval_fn(vec, 0.0))
+        loss_100 = float(eval_fn(vec, 100.0))
+        assert np.isfinite(loss_0) and np.isfinite(loss_100)
+        assert loss_100 < loss_0, "100 SGD steps did not improve val loss"
+
+    def test_vmappable_and_jittable(self, eval_fn):
+        cs = mlp_space(seed=1)
+        X = jnp.asarray(cs.sample_vectors(8), jnp.float32)
+        losses = jax.jit(
+            lambda xs, b: jax.vmap(lambda v: eval_fn(v, b))(xs)
+        )(X, jnp.float32(20.0))
+        assert losses.shape == (8,)
+        assert np.isfinite(np.asarray(losses)).all()
+
+    def test_bad_lr_worse_than_good_lr(self, eval_fn):
+        cs = mlp_space(seed=2)
+        good = {"lr": 0.05, "momentum": 0.9, "weight_decay": 1e-6, "init_scale": 1.0}
+        bad = {"lr": 1.0, "momentum": 0.99, "weight_decay": 1e-2, "init_scale": 10.0}
+        lg = float(eval_fn(jnp.asarray(cs.to_vector(good), jnp.float32), 150.0))
+        lb = float(eval_fn(jnp.asarray(cs.to_vector(bad), jnp.float32), 150.0))
+        assert lg < lb
+
+
+class TestProfilerHook:
+    def test_attach_profiler_smoke(self, tmp_path):
+        from hpbandster_tpu.parallel import BatchedExecutor, VmapBackend
+        from hpbandster_tpu.utils.profiling import attach_profiler
+        from hpbandster_tpu.optimizers import HyperBand
+
+        cs = branin_space(seed=0)
+        executor = BatchedExecutor(VmapBackend(branin_from_vector), cs)
+        attach_profiler(executor, str(tmp_path / "trace"))
+        opt = HyperBand(
+            configspace=cs, run_id="prof", executor=executor,
+            min_budget=1, max_budget=9, eta=3, seed=0,
+        )
+        res = opt.run(n_iterations=1)
+        opt.shutdown()
+        assert res.get_incumbent_id() is not None
+        assert (tmp_path / "trace").exists()
