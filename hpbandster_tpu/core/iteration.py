@@ -53,6 +53,8 @@ class Datum:
         self.results: Dict[float, Optional[float]] = results or {}
         self.time_stamps: Dict[float, Dict[str, float]] = time_stamps or {}
         self.exceptions: Dict[float, Optional[str]] = exceptions or {}
+        #: per-budget user 'info' payloads from compute()/eval backends
+        self.infos: Dict[float, Any] = {}
         self.status = status
         self.budget = budget
 
@@ -195,6 +197,8 @@ class BaseIteration:
         datum.results[budget] = None if np.isnan(loss) else loss
         datum.exceptions[budget] = job.exception
         datum.time_stamps[budget] = dict(job.timestamps)
+        if isinstance(job.result, dict) and "info" in job.result:
+            datum.infos[budget] = job.result["info"]
         # crashed evaluations stay in the bracket as REVIEW with a None loss —
         # they are simply never promoted (reference: crashed-as-worst, §5)
         datum.status = Status.REVIEW

@@ -160,6 +160,14 @@ class Master:
             budget=budget,
             working_directory=self.working_directory,
         )
+        # bracket shape piggybacks on the job so batched executors can fuse
+        # an entire bracket into one device computation (ops/fused.py)
+        it = self.iterations[config_id[0]]
+        job.bracket_info = {
+            "num_configs": tuple(it.num_configs),
+            "budgets": tuple(it.budgets),
+            "stage": it.stage,
+        }
         job.time_it("submitted")
         with self.thread_cond:
             self.num_running_jobs += 1

@@ -103,7 +103,7 @@ class Result:
         for budget in sorted(d.results.keys()):
             err = d.exceptions.get(budget)
             res = d.results[budget]
-            info = d.config_info.get("_run_info", {}).get(budget) if d.config_info else None
+            info = getattr(d, "infos", {}).get(budget)
             runs.append(
                 Run(
                     config_id=tuple(config_id),
@@ -356,6 +356,8 @@ def logged_results_to_HBS_result(directory: str) -> Result:
             d = data[cid]
             d.time_stamps[budget] = time_stamps
             d.results[budget] = None if result is None else result.get("loss")
+            if result is not None and "info" in result:
+                d.infos[budget] = result["info"]
             d.exceptions[budget] = exception
             d.budget = budget
             d.status = Status.REVIEW

@@ -1,0 +1,158 @@
+"""Fused successive-halving bracket: all stages in ONE device computation.
+
+The north-star capability (SURVEY.md §0, BASELINE.json): "per-bracket
+allocation decided on-device". Stage evaluations, the top-k promotion
+decision, and the gather of surviving configs all happen inside a single
+jitted function — zero host round-trips between stages, so a whole bracket
+is one dispatch regardless of depth.
+
+Shapes are fully static: ``num_configs``/``budgets`` are Python tuples
+closed over at trace time, each stage's survivor batch has its statically
+known size, and budget-dependent training loops see a *concrete* budget
+(enabling static trip counts). Crashed configs surface as NaN losses and
+rank behind every clean loss in the on-device promotion (but ahead of
+mesh-padding rows), index-stably — matching ``sh_promotion_mask``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fused_sh_bracket", "make_fused_bracket_fn"]
+
+#: crashed (NaN) losses map here for ranking: behind any real loss, ahead of
+#: the +inf padding rows, ties broken index-stably by top_k — the same
+#: ordering sh_promotion_mask's argsort produces host-side.
+_CRASH_RANK = jnp.float32(3.0e38)
+
+
+def fused_sh_bracket(
+    eval_fn: Callable[[jax.Array, float], jax.Array],
+    vectors: jax.Array,
+    num_configs: Sequence[int],
+    budgets: Sequence[float],
+) -> List[Tuple[jax.Array, jax.Array]]:
+    """Trace one whole bracket. Returns per-stage ``(indices, losses)``
+    where ``indices`` index the original (unpadded) stage-0 rows.
+
+    ``vectors`` may carry extra padding rows beyond ``num_configs[0]`` (for
+    mesh divisibility); they are evaluated but can never be promoted. Must
+    run under ``jit`` (see :func:`make_fused_bracket_fn`).
+    """
+    n0 = int(num_configs[0])
+    n_rows = vectors.shape[0]
+    if n_rows < n0:
+        raise ValueError(f"need >= {n0} stage-0 vectors, got {n_rows}")
+
+    def eval_stage(vecs: jax.Array, budget: float) -> jax.Array:
+        return jax.vmap(lambda v: eval_fn(v, budget))(vecs).astype(jnp.float32)
+
+    def rank_key(losses: jax.Array, is_pad: jax.Array) -> jax.Array:
+        key = jnp.where(jnp.isnan(losses), _CRASH_RANK, losses)
+        return jnp.where(is_pad, jnp.inf, key)
+
+    losses0 = eval_stage(vectors, float(budgets[0]))
+    cur_idx = jnp.arange(n_rows, dtype=jnp.int32)
+    cur_key = rank_key(losses0, cur_idx >= n0)
+    out = [(jnp.arange(n0, dtype=jnp.int32), losses0[:n0])]
+
+    for s in range(1, len(num_configs)):
+        k = int(num_configs[s])
+        _, top = jax.lax.top_k(-cur_key, k)
+        top = jnp.sort(top)  # preserve original ordering among survivors
+        sel_idx = cur_idx[top]
+        sel_vecs = vectors[sel_idx]
+        losses_s = eval_stage(sel_vecs, float(budgets[s]))
+        cur_idx = sel_idx
+        cur_key = rank_key(losses_s, jnp.zeros_like(sel_idx, dtype=bool))
+        out.append((cur_idx, losses_s))
+    return out
+
+
+def _pack_stages(stages):
+    """Concatenate per-stage (idx, losses) into two flat arrays — a single
+    pair of device->host transfers instead of two per stage (the transfer
+    count, not bytes, dominates on high-latency links)."""
+    return (
+        jnp.concatenate([s[0] for s in stages]),
+        jnp.concatenate([s[1] for s in stages]),
+    )
+
+
+def _unpack_stages(packed, num_configs):
+    import numpy as np
+
+    idx_flat = np.asarray(packed[0])
+    loss_flat = np.asarray(packed[1])
+    out, off = [], 0
+    for k in num_configs:
+        out.append((idx_flat[off:off + k], loss_flat[off:off + k]))
+        off += k
+    return out
+
+
+#: process-wide compiled-bracket cache: optimizer/executor instances come
+#: and go (warmups, repeated runs), but a (objective, bracket shape, mesh)
+#: combination should compile exactly once per process
+_FUSED_FN_CACHE: dict = {}
+
+
+def make_fused_bracket_fn(
+    eval_fn: Callable[[jax.Array, float], jax.Array],
+    num_configs: Sequence[int],
+    budgets: Sequence[float],
+    mesh=None,
+    axis: str = "config",
+):
+    """Compile a fused-bracket runner for one bracket shape.
+
+    Returns ``fn(vectors[n0, d]) -> [(indices, losses), ...]``. With a mesh,
+    the stage-0 batch is padded to the mesh size and sharded over ``axis``;
+    XLA inserts the all-gathers the cross-shard top-k needs.
+    """
+    import numpy as np
+
+    num_configs = tuple(int(n) for n in num_configs)
+    budgets = tuple(float(b) for b in budgets)
+    cache_key = (eval_fn, num_configs, budgets, mesh, axis)
+    cached = _FUSED_FN_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
+    n0 = num_configs[0]
+
+    def bracket(vectors: jax.Array):
+        return _pack_stages(
+            fused_sh_bracket(eval_fn, vectors, num_configs, budgets)
+        )
+
+    if mesh is None:
+        jitted_plain = jax.jit(bracket)
+
+        def runner(vectors):
+            return _unpack_stages(jitted_plain(vectors), num_configs)
+
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        m = int(np.prod(list(mesh.shape.values())))
+        n_pad = ((n0 + m - 1) // m) * m
+        shard = NamedSharding(mesh, PartitionSpec(axis))
+        jitted = jax.jit(bracket, in_shardings=(shard,))
+
+        def runner(vectors):
+            vectors = np.asarray(vectors, np.float32)
+            if vectors.shape[0] != n0:
+                raise ValueError(
+                    f"expected {n0} stage-0 vectors, got {vectors.shape[0]}"
+                )
+            if n_pad != n0:
+                vectors = np.concatenate(
+                    [vectors, np.zeros((n_pad - n0, vectors.shape[1]), np.float32)]
+                )
+            return _unpack_stages(jitted(vectors), num_configs)
+
+    _FUSED_FN_CACHE[cache_key] = runner
+    return runner

@@ -2,17 +2,29 @@
 
 Implements the executor seam (see ``core/master.py``): jobs submitted by the
 Master are buffered; when the Master runs out of ready work it calls
-``flush()``, which groups the buffer by budget, encodes configs to vectors,
-runs each budget group as ONE backend dispatch, and fires the result
+``flush()``, which evaluates the buffer on-device and fires the result
 callback for every job synchronously. Non-finite losses become crashed jobs
 (result ``None`` + exception string), reproducing the reference's
 crashed-evaluation semantics (SURVEY.md §5) inside the batch.
+
+Two evaluation modes:
+
+* **stage batching** (always on): buffered jobs group by budget; each group
+  is one backend dispatch.
+* **bracket fusion** (``fuse_brackets=True``, default): when the buffer is a
+  complete stage-0 wave of one bracket, the WHOLE bracket — every stage plus
+  the top-k promotion decisions — runs as one jitted computation
+  (``ops/fused.py``). Later-stage results are then served from a cache the
+  instant the Master's own (identical) promotion rule re-queues the
+  survivors. If the host promotes a different set (e.g. H2BO's
+  learning-curve rule), the mismatching configs simply fall back to the
+  stage-batched path — fusion is an optimization, never a semantics change.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -38,14 +50,21 @@ class BatchedExecutor:
         self,
         backend,
         configspace: ConfigurationSpace,
+        fuse_brackets: bool = True,
         logger: Optional[logging.Logger] = None,
     ):
         self.backend = backend
         self.configspace = configspace
+        self.fuse_brackets = bool(fuse_brackets) and hasattr(backend, "eval_fn")
         self.logger = logger or logging.getLogger("hpbandster_tpu.batched_executor")
         self.buffer: List[Job] = []
         self._new_result_callback: Optional[Callable[[Job], None]] = None
         self.total_evaluated = 0
+        #: (config_id, budget) -> loss computed ahead of time by a fused bracket
+        self._fused_cache: Dict[Tuple[Any, float], float] = {}
+        #: (num_configs, budgets) -> compiled fused bracket fn
+        self._fused_fns: Dict[Tuple, Callable] = {}
+        self.fused_brackets_run = 0
 
     # -------------------------------------------------------- executor seam
     def start(self, new_result_callback, new_worker_callback) -> None:
@@ -61,14 +80,106 @@ class BatchedExecutor:
     def n_waiting(self) -> int:
         return len(self.buffer)
 
+    # ------------------------------------------------------------- delivery
+    def _finish(self, job: Job, loss: float) -> None:
+        job.time_it("finished")
+        if np.isfinite(loss):
+            job.result = {"loss": float(loss), "info": {}}
+        else:
+            job.result = None
+            job.exception = job.exception or (
+                f"non-finite loss {loss!r} at budget {job.kwargs['budget']}"
+            )
+        self.total_evaluated += 1
+        self._new_result_callback(job)
+
+    # ---------------------------------------------------------- fused path
+    def _try_fuse(self, jobs: List[Job]) -> Optional[List[Job]]:
+        """If ``jobs`` is one bracket's complete stage-0 wave, run the whole
+        bracket fused; returns the remaining (non-fused) jobs or None if
+        fusion did not apply."""
+        info = getattr(jobs[0], "bracket_info", None)
+        if info is None or info["stage"] != 0 or len(info["num_configs"]) < 2:
+            return None
+        iteration = jobs[0].id[0]
+        same = all(
+            getattr(j, "bracket_info", None) == info and j.id[0] == iteration
+            for j in jobs
+        )
+        if not same or len(jobs) != info["num_configs"][0]:
+            return None
+
+        from hpbandster_tpu.ops.fused import make_fused_bracket_fn
+
+        shape_key = (info["num_configs"], info["budgets"])
+        if shape_key not in self._fused_fns:
+            self._fused_fns[shape_key] = make_fused_bracket_fn(
+                self.backend.eval_fn,
+                info["num_configs"],
+                info["budgets"],
+                mesh=getattr(self.backend, "mesh", None),
+                axis=getattr(self.backend, "axis", "config"),
+            )
+
+        jobs_sorted = sorted(jobs, key=lambda j: j.id)
+        vectors = np.stack(
+            [
+                np.nan_to_num(
+                    self.configspace.to_vector(j.kwargs["config"]), nan=0.0
+                )
+                for j in jobs_sorted
+            ]
+        ).astype(np.float32)
+        for j in jobs_sorted:
+            j.time_it("started")
+        stages = self._fused_fns[shape_key](vectors)
+        self.fused_brackets_run += 1
+
+        # stage 0 results feed back immediately; stages >= 1 fill the cache
+        stage0_losses = np.asarray(stages[0][1])
+        for s, (idx, losses) in enumerate(stages[1:], start=1):
+            idx = np.asarray(idx)
+            losses = np.asarray(losses)
+            budget = info["budgets"][s]
+            for i, loss in zip(idx, losses):
+                cid = jobs_sorted[int(i)].id
+                self._fused_cache[(cid, float(budget))] = float(loss)
+        self.logger.debug(
+            "fused bracket %d: %s evals in one dispatch",
+            iteration, sum(len(np.asarray(i)) for i, _ in stages),
+        )
+        for j, loss in zip(jobs_sorted, stage0_losses):
+            self._finish(j, loss)
+        return []
+
+    # -------------------------------------------------------------- flush
     def flush(self) -> bool:
         """Evaluate everything buffered; returns True if any job ran."""
         if not self.buffer:
             return False
         jobs, self.buffer = self.buffer, []
 
-        by_budget: Dict[float, List[Job]] = {}
+        # serve results a fused bracket already computed
+        remaining: List[Job] = []
         for job in jobs:
+            key = (job.id, float(job.kwargs["budget"]))
+            if key in self._fused_cache:
+                job.time_it("started")
+                self._finish(job, self._fused_cache.pop(key))
+            else:
+                remaining.append(job)
+        if not remaining:
+            return True
+
+        if self.fuse_brackets:
+            fused_rest = self._try_fuse(remaining)
+            if fused_rest is not None:
+                remaining = fused_rest
+                if not remaining:
+                    return True
+
+        by_budget: Dict[float, List[Job]] = {}
+        for job in remaining:
             by_budget.setdefault(float(job.kwargs["budget"]), []).append(job)
 
         for budget, group in sorted(by_budget.items()):
@@ -89,17 +200,8 @@ class BatchedExecutor:
                 losses = np.full(len(group), np.nan)
                 for j in group:
                     j.exception = f"batched evaluation failed: {e!r}"
-            self.total_evaluated += len(group)
             for j, loss in zip(group, losses):
-                j.time_it("finished")
-                if np.isfinite(loss):
-                    j.result = {"loss": float(loss), "info": {}}
-                else:
-                    j.result = None
-                    j.exception = j.exception or (
-                        f"non-finite loss {loss!r} at budget {budget}"
-                    )
-                self._new_result_callback(j)
+                self._finish(j, loss)
         return True
 
     def shutdown(self, shutdown_workers: bool = False) -> None:

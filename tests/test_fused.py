@@ -1,0 +1,99 @@
+"""Tests for the fused on-device bracket (ops/fused.py + executor path)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hpbandster_tpu.ops.bracket import sh_promotion_mask
+from hpbandster_tpu.ops.fused import make_fused_bracket_fn
+from hpbandster_tpu.optimizers import BOHB, HyperBand
+from hpbandster_tpu.parallel import BatchedExecutor, VmapBackend, config_mesh
+
+from tests.toys import branin_from_vector, branin_space
+
+
+def quad_eval(vec, budget):
+    """Deterministic objective independent of budget (easy cross-checks)."""
+    return jnp.sum(jnp.square(vec - 0.3))
+
+
+class TestFusedKernel:
+    def test_matches_host_promotion(self, rng):
+        X = rng.uniform(size=(9, 2)).astype(np.float32)
+        fn = make_fused_bracket_fn(quad_eval, (9, 3, 1), (1.0, 3.0, 9.0))
+        stages = fn(jnp.asarray(X))
+        assert len(stages) == 3
+        idx0, losses0 = map(np.asarray, stages[0])
+        assert idx0.tolist() == list(range(9))
+        # device promotion set == host promotion mask, stage by stage
+        mask = np.asarray(sh_promotion_mask(losses0, 3))
+        idx1 = np.asarray(stages[1][0])
+        assert sorted(idx1.tolist()) == sorted(np.where(mask)[0].tolist())
+        mask2 = np.asarray(sh_promotion_mask(np.asarray(stages[1][1]), 1))
+        idx2 = np.asarray(stages[2][0])
+        assert idx2.tolist() == [idx1[i] for i in np.where(mask2)[0]]
+
+    def test_crashed_never_promoted_on_device(self, rng):
+        def crashy(vec, budget):
+            val = jnp.sum(jnp.square(vec - 0.3))
+            return jnp.where(vec[0] > 0.5, jnp.nan, val)
+
+        X = np.linspace(0, 1, 8)[:, None].repeat(2, 1).astype(np.float32)
+        fn = make_fused_bracket_fn(crashy, (8, 2), (1.0, 3.0))
+        stages = fn(jnp.asarray(X))
+        promoted = np.asarray(stages[1][0])
+        # all promoted rows have vec[0] <= 0.5
+        assert (X[promoted, 0] <= 0.5).all()
+
+    def test_sharded_with_padding(self, rng):
+        mesh = config_mesh(jax.devices())  # 8 virtual CPU devices
+        X = rng.uniform(size=(9, 2)).astype(np.float32)  # 9 % 8 != 0
+        fn = make_fused_bracket_fn(
+            quad_eval, (9, 3, 1), (1.0, 3.0, 9.0), mesh=mesh
+        )
+        stages = fn(X)
+        idx1 = np.asarray(stages[1][0])
+        assert (idx1 < 9).all(), "padding row leaked into promotion"
+        losses0 = np.asarray(stages[0][1])
+        mask = np.asarray(sh_promotion_mask(losses0, 3))
+        assert sorted(idx1.tolist()) == sorted(np.where(mask)[0].tolist())
+
+
+class TestFusedExecutorPath:
+    def test_hyperband_uses_fusion_and_matches_counts(self):
+        cs = branin_space(seed=0)
+        executor = BatchedExecutor(
+            VmapBackend(branin_from_vector), cs, fuse_brackets=True
+        )
+        opt = HyperBand(
+            configspace=cs, run_id="fused", executor=executor,
+            min_budget=1, max_budget=9, eta=3, seed=0,
+        )
+        res = opt.run(n_iterations=3)
+        opt.shutdown()
+        assert executor.fused_brackets_run == 2  # brackets with >= 2 stages
+        assert executor.total_evaluated == 22
+        assert len(res.get_all_runs()) == 22
+        assert not executor._fused_cache, "unused fused results leaked"
+
+    def test_fused_equals_unfused_results(self):
+        def run(fuse):
+            cs = branin_space(seed=1)
+            executor = BatchedExecutor(
+                VmapBackend(branin_from_vector), cs, fuse_brackets=fuse
+            )
+            opt = BOHB(
+                configspace=cs, run_id="cmp", executor=executor,
+                min_budget=1, max_budget=9, eta=3, seed=1, min_points_in_model=4,
+            )
+            res = opt.run(n_iterations=4)
+            opt.shutdown()
+            return res
+
+        res_f, res_u = run(True), run(False)
+        runs_f = {(r.config_id, r.budget): r.loss for r in res_f.get_all_runs()}
+        runs_u = {(r.config_id, r.budget): r.loss for r in res_u.get_all_runs()}
+        assert set(runs_f) == set(runs_u)
+        for key in runs_f:
+            assert runs_f[key] == pytest.approx(runs_u[key], rel=1e-5), key
