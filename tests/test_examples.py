@@ -13,6 +13,10 @@ EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
 def run_example(name, *args, timeout=240):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # the sandbox's sitecustomize force-registers a tunneled TPU platform
+    # when this var is set, overriding JAX_PLATFORMS — examples must run on
+    # the local CPU backend to be fast and deterministic
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     env["PYTHONPATH"] = (
         os.path.abspath(os.path.join(EXAMPLES, ""))
         + os.pathsep
@@ -52,7 +56,7 @@ def test_example_3_processes():
 def test_example_5_mlp_worker():
     out = run_example(
         "example_5_mlp_worker.py", "--n_workers", "1", "--n_iterations", "1",
-        "--min_budget", "5", "--max_budget", "45",
+        "--min_budget", "5", "--max_budget", "15", timeout=420,
     )
     assert "val loss at max budget" in out
 
