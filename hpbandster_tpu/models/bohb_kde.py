@@ -59,10 +59,22 @@ class BOHBKDE(base_config_generator):
         min_bandwidth: float = 1e-3,
         seed: Optional[int] = None,
         proposal_batch_size: int = 128,
+        use_pallas: Optional[bool] = None,
         **kwargs,
     ):
         super().__init__(**kwargs)
         self.configspace = configspace
+        # opt-in Pallas scorer for the proposal hot loop (ops/pallas_kde.py);
+        # None -> env HPB_USE_PALLAS=1 + a TPU backend enables it
+        if use_pallas is None:
+            import os
+
+            use_pallas = os.environ.get("HPB_USE_PALLAS", "") == "1"
+        if use_pallas:
+            from hpbandster_tpu.ops.pallas_kde import pallas_available
+
+            use_pallas = pallas_available()
+        self.use_pallas = bool(use_pallas)
         # every stage's proposals run at this fixed batch size (sliced down
         # to what's needed): one compiled kernel serves all bracket shapes.
         # Extra candidates are nearly free on-device; recompiles are not.
@@ -189,6 +201,27 @@ class BOHBKDE(base_config_generator):
         bw = np.clip(bw, self.min_bandwidth, cap_discrete).astype(np.float32)
         return KDE(padded, mask, bw)
 
+    def _propose_batch_pallas(self, seed, good, bad, n: int) -> np.ndarray:
+        """Pallas-scored proposals: generation + scoring split so the fused
+        TPU kernel handles both KDE log-pdfs and the acquisition ratio."""
+        from hpbandster_tpu.ops.kde import generate_candidates_seeded
+        from hpbandster_tpu.ops.pallas_kde import pallas_score_candidates
+
+        from hpbandster_tpu.ops.pallas_kde import pallas_available
+
+        cands = generate_candidates_seeded(
+            seed, good, self.vartypes, self.cards, n, self.num_samples,
+            self.bandwidth_factor, self.min_bandwidth,
+        )
+        scores = pallas_score_candidates(
+            cands, good, bad, self.vartypes, self.cards,
+            interpret=not pallas_available(),  # CPU tests run interpreted
+        )
+        scores = np.asarray(scores).reshape(n, self.num_samples)
+        cands = np.asarray(cands).reshape(n, self.num_samples, -1)
+        best = scores.argmax(axis=1)
+        return cands[np.arange(n), best]
+
     # ----------------------------------------------------------- checkpoint
     def get_state(self) -> Dict[str, Any]:
         """Picklable snapshot: observations + RNG; KDEs refit on restore."""
@@ -274,19 +307,22 @@ class BOHBKDE(base_config_generator):
             # fresh XLA compile. Keys derive on-device from one scalar seed.
             n_pad = _pow2_capacity(n_model, minimum=self.proposal_batch_size)
             seed = jnp.uint32(self.rng.integers(2**32, dtype=np.uint32))
-            vecs = np.asarray(
-                propose_batch_seeded(
-                    seed,
-                    good,
-                    bad,
-                    self.vartypes,
-                    self.cards,
-                    n_pad,
-                    self.num_samples,
-                    self.bandwidth_factor,
-                    self.min_bandwidth,
-                )
-            )[:n_model]
+            if self.use_pallas:
+                vecs = self._propose_batch_pallas(seed, good, bad, n_pad)[:n_model]
+            else:
+                vecs = np.asarray(
+                    propose_batch_seeded(
+                        seed,
+                        good,
+                        bad,
+                        self.vartypes,
+                        self.cards,
+                        n_pad,
+                        self.num_samples,
+                        self.bandwidth_factor,
+                        self.min_bandwidth,
+                    )
+                )[:n_model]
             k = 0
             for i in range(n):
                 if use_model[i]:

@@ -1,0 +1,209 @@
+"""Pallas TPU kernel for the BOHB acquisition scorer.
+
+The proposal hot loop scores ``n_configs x num_samples`` candidates against
+two mixed-type KDEs (good/bad) — for a 128-proposal stage with the default
+64 samples that is ~8k candidates x 2 KDEs x up to 256 observations x d
+dims of product-kernel work plus two logsumexps. This kernel fuses the
+whole thing: one VMEM-resident pass per candidate tile computes both
+mixture log-densities dim-by-dim (Gaussian / Aitchison–Aitken /
+Wang–van Ryzin selected per dim, matching ``ops.kde``) and emits the
+floored acquisition score ``max(lg, F) - max(lb, F)`` directly.
+
+Layout notes (see /opt/skills/guides/pallas_guide.md):
+* candidates tile over the grid, 128 rows per program;
+* observation matrices are passed TRANSPOSED (``[d, n_obs]``) so each dim
+  is one lane-aligned row broadcast against the candidate column;
+* the dim loop is a static Python unroll (d is small in HPO spaces);
+* dims are padded to the 128-lane width with vartype code 3 = "inert"
+  (zero log-kernel contribution), observations with mask 0.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hpbandster_tpu.ops.kde import KDE, LOG_PDF_FLOOR
+
+__all__ = ["pallas_score_candidates", "pallas_available"]
+
+_LOG_SQRT_2PI = 0.5 * math.log(2.0 * math.pi)
+_TILE_S = 128
+_LANE = 128
+
+
+def pallas_available() -> bool:
+    """Pallas TPU lowering requires a TPU-family backend."""
+    try:
+        platform = jax.devices()[0].platform.lower()
+    except Exception:
+        return False
+    return platform in ("tpu", "axon")
+
+
+def _pad_to(x: np.ndarray, shape: Tuple[int, ...], fill: float) -> np.ndarray:
+    out = np.full(shape, fill, dtype=np.float32)
+    out[tuple(slice(0, s) for s in x.shape)] = x
+    return out
+
+
+def _score_kernel(
+    d_actual: int,
+    cand_ref,
+    goodT_ref,
+    gmask_ref,
+    gbw_ref,
+    badT_ref,
+    bmask_ref,
+    bbw_ref,
+    vt_ref,
+    card_ref,
+    out_ref,
+):
+    ts = cand_ref.shape[0]
+
+    def mixture_logpdf(dataT_ref, mask_ref, bw_ref):
+        n = dataT_ref.shape[1]
+        acc = jnp.zeros((ts, n), jnp.float32)
+        for j in range(d_actual):  # static unroll over real dims
+            x = cand_ref[:, j:j + 1]  # [TS, 1]
+            mu = dataT_ref[j:j + 1, :]  # [1, N]
+            bw = jnp.maximum(bw_ref[0, j], 1e-10)
+            vt = vt_ref[0, j]
+            km1 = jnp.maximum(card_ref[0, j] - 1.0, 1.0)
+            diff = x - mu  # [TS, N]
+
+            log_c = -0.5 * jnp.square(diff / bw) - jnp.log(bw) - _LOG_SQRT_2PI
+            same = jnp.square(diff) < 0.25
+            lam = jnp.clip(bw, 1e-10, 1.0 - 1e-7)
+            log_u = jnp.where(
+                same, jnp.log1p(-lam), jnp.log(lam) - jnp.log(km1)
+            )
+            log_o = jnp.where(
+                same,
+                jnp.log1p(-lam),
+                math.log(0.5) + jnp.log1p(-lam) + jnp.abs(diff) * jnp.log(lam),
+            )
+            term = jnp.where(
+                vt == 0.0,
+                log_c,
+                jnp.where(vt == 1.0, log_u, jnp.where(vt == 2.0, log_o, 0.0)),
+            )
+            acc = acc + term
+        log_w = jnp.where(mask_ref[0:1, :] > 0.0, 0.0, -jnp.inf)
+        ll = acc + log_w
+        m = jnp.max(ll, axis=1, keepdims=True)
+        m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+        s = jnp.sum(jnp.exp(ll - m_safe), axis=1, keepdims=True)
+        n_eff = jnp.maximum(jnp.sum(mask_ref[:]), 1.0)
+        return m_safe + jnp.log(jnp.maximum(s, 1e-38)) - jnp.log(n_eff)
+
+    lg = mixture_logpdf(goodT_ref, gmask_ref, gbw_ref)
+    lb = mixture_logpdf(badT_ref, bmask_ref, bbw_ref)
+    out_ref[:] = jnp.maximum(lg, LOG_PDF_FLOOR) - jnp.maximum(lb, LOG_PDF_FLOOR)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("d_actual", "interpret")
+)
+def _score_padded(
+    cands,  # [S_pad, D_pad]
+    goodT,  # [D_pad, Ng_pad]
+    gmask,  # [1, Ng_pad]
+    gbw,    # [1, D_pad]
+    badT,
+    bmask,
+    bbw,
+    vt,     # [1, D_pad] float codes (3.0 = inert pad dim)
+    cards,  # [1, D_pad]
+    d_actual: int,
+    interpret: bool,
+):
+    from jax.experimental import pallas as pl
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        vmem = pltpu.VMEM
+    except ImportError:  # pragma: no cover
+        vmem = None
+
+    s_pad, d_pad = cands.shape
+    grid = (s_pad // _TILE_S,)
+
+    def spec(shape, index_map):
+        if vmem is None:
+            return pl.BlockSpec(shape, index_map)
+        return pl.BlockSpec(shape, index_map, memory_space=vmem)
+
+    full = lambda arr: spec(arr.shape, lambda i: (0, 0))  # noqa: E731
+
+    return pl.pallas_call(
+        functools.partial(_score_kernel, d_actual),
+        out_shape=jax.ShapeDtypeStruct((s_pad, 1), jnp.float32),
+        grid=grid,
+        in_specs=[
+            spec((_TILE_S, d_pad), lambda i: (i, 0)),
+            full(goodT),
+            full(gmask),
+            full(gbw),
+            full(badT),
+            full(bmask),
+            full(bbw),
+            full(vt),
+            full(cards),
+        ],
+        out_specs=spec((_TILE_S, 1), lambda i: (i, 0)),
+        interpret=interpret,
+    )(cands, goodT, gmask, gbw, badT, bmask, bbw, vt, cards)
+
+
+def pallas_score_candidates(
+    cands: jax.Array,
+    good: KDE,
+    bad: KDE,
+    vartypes,
+    cards,
+    interpret: bool = False,
+) -> jax.Array:
+    """Score ``f32[S, d]`` candidates; returns ``f32[S]`` acquisition scores.
+
+    Drop-in replacement for the XLA path
+    ``max(logpdf_good, F) - max(logpdf_bad, F)`` (see ``ops.kde.propose``).
+    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU tests).
+    """
+    cands = np.asarray(cands, np.float32)
+    s, d = cands.shape
+    s_pad = ((s + _TILE_S - 1) // _TILE_S) * _TILE_S
+    d_pad = _LANE
+
+    def prep(kde: KDE):
+        data = np.asarray(kde.data, np.float32)
+        mask = np.asarray(kde.mask, np.float32)
+        bw = np.asarray(kde.bw, np.float32)
+        n_pad = ((data.shape[0] + _LANE - 1) // _LANE) * _LANE
+        dataT = _pad_to(data.T, (d_pad, n_pad), 0.0)
+        mask2 = _pad_to(mask[None, :], (1, n_pad), 0.0)
+        bw2 = _pad_to(bw[None, :], (1, d_pad), 1.0)
+        return dataT, mask2, bw2
+
+    goodT, gmask, gbw = prep(good)
+    badT, bmask, bbw = prep(bad)
+    vt = _pad_to(
+        np.asarray(vartypes, np.float32)[None, :], (1, d_pad), 3.0
+    )
+    cd = _pad_to(np.asarray(cards, np.float32)[None, :], (1, d_pad), 1.0)
+    cpad = _pad_to(cands, (s_pad, d_pad), 0.0)
+
+    out = _score_padded(
+        jnp.asarray(cpad), jnp.asarray(goodT), jnp.asarray(gmask),
+        jnp.asarray(gbw), jnp.asarray(badT), jnp.asarray(bmask),
+        jnp.asarray(bbw), jnp.asarray(vt), jnp.asarray(cd),
+        d_actual=d, interpret=interpret,
+    )
+    return out[:s, 0]
