@@ -75,3 +75,9 @@ def test_example_7_tpu_batched():
         "--min_budget", "5", "--max_budget", "45",
     )
     assert "configs/s" in out
+
+
+def test_example_8_large_sweep():
+    out = run_example("example_8_large_sweep.py", "--n_iterations", "6")
+    assert "incumbent loss" in out
+    assert "fused" in out

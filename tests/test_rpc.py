@@ -1,0 +1,109 @@
+"""Unit tests for the TCP RPC transport (the Pyro4-replacement layer)."""
+
+import threading
+
+import pytest
+
+from hpbandster_tpu.parallel.rpc import (
+    CommunicationError,
+    RPCError,
+    RPCProxy,
+    RPCServer,
+)
+
+
+@pytest.fixture
+def server():
+    srv = RPCServer("127.0.0.1", 0)
+    srv.register("echo", lambda x: x)
+    srv.register("add", lambda a, b: a + b)
+
+    def boom():
+        raise ValueError("kaboom")
+
+    srv.register("boom", boom)
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+class TestRPC:
+    def test_basic_call(self, server):
+        proxy = RPCProxy(server.uri)
+        assert proxy.call("echo", x={"nested": [1, 2.5, "s", None]}) == {
+            "nested": [1, 2.5, "s", None]
+        }
+        assert proxy.call("add", a=2, b=3) == 5
+        # attribute-style sugar
+        assert proxy.add(a=1, b=1) == 2
+
+    def test_unknown_method(self, server):
+        with pytest.raises(RPCError, match="unknown method"):
+            RPCProxy(server.uri).call("nope")
+
+    def test_remote_exception_carries_traceback(self, server):
+        with pytest.raises(RPCError, match="kaboom"):
+            RPCProxy(server.uri).call("boom")
+
+    def test_dead_peer_is_communication_error(self, server):
+        uri = server.uri
+        server.shutdown()
+        with pytest.raises(CommunicationError):
+            RPCProxy(uri, timeout=1).call("echo", x=1)
+
+    def test_concurrent_calls(self, server):
+        results, errors = [], []
+
+        def hammer(i):
+            try:
+                results.append(RPCProxy(server.uri).call("add", a=i, b=i))
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=hammer, args=(i,)) for i in range(20)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert sorted(results) == [2 * i for i in range(20)]
+
+    def test_register_instance(self):
+        class Service:
+            def ping(self):
+                return "pong"
+
+            def _private(self):  # must not be exposed
+                return "secret"
+
+        srv = RPCServer("127.0.0.1", 0)
+        srv.register_instance(Service())
+        srv.start()
+        try:
+            assert RPCProxy(srv.uri).call("ping") == "pong"
+            with pytest.raises(RPCError, match="unknown method"):
+                RPCProxy(srv.uri).call("_private")
+        finally:
+            srv.shutdown()
+
+
+class TestUtils:
+    def test_nic_name_to_host(self):
+        from hpbandster_tpu.utils import nic_name_to_host
+
+        assert nic_name_to_host(None) == "127.0.0.1"
+        # loopback interface resolves on linux; unknown NICs fall back
+        assert nic_name_to_host("lo") == "127.0.0.1"
+        host = nic_name_to_host("definitely-not-a-nic")
+        assert isinstance(host, str) and host
+
+    def test_start_local_nameserver(self):
+        from hpbandster_tpu.utils import start_local_nameserver
+
+        ns, host, port = start_local_nameserver()
+        try:
+            from hpbandster_tpu.parallel.rpc import RPCProxy
+
+            assert RPCProxy(f"{host}:{port}").call("ping") == "pong"
+        finally:
+            ns.shutdown()
