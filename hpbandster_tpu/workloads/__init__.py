@@ -9,6 +9,23 @@ from hpbandster_tpu.workloads.toys import (  # noqa: F401
     hartmann6_from_vector,
     hartmann6_space,
 )
+from hpbandster_tpu.workloads.cnn import (  # noqa: F401
+    CNNConfig,
+    cnn_forward,
+    cnn_space,
+    decode_cnn_hparams,
+    init_cnn_params,
+    make_cnn_eval_fn,
+    make_image_dataset,
+)
+from hpbandster_tpu.workloads.resnet import (  # noqa: F401
+    ResNetConfig,
+    decode_resnet_hparams,
+    init_resnet_params,
+    make_resnet_eval_fn,
+    resnet_forward,
+    resnet_space,
+)
 from hpbandster_tpu.workloads.mlp import (  # noqa: F401
     MLPConfig,
     batched_sgd_train_step,

@@ -1,0 +1,218 @@
+"""CNN hyperparameter-search workload — BASELINE.md rung 4 (CNN/CIFAR-10).
+
+Every config is a full conv-net training run on CIFAR-shaped images, and the
+whole config batch trains simultaneously: parameters for all configs are
+stacked on a leading config axis and the training loop is one ``vmap``-ed,
+jitted computation (the same contract as ``workloads.mlp``).
+
+TPU-first choices:
+
+* convolutions and the classifier matmul run in **bfloat16** with float32
+  accumulation (``preferred_element_type``) — the MXU's native regime;
+  parameters and optimizer state stay float32.
+* NHWC layout with channel counts that tile onto the MXU lanes.
+* budget = number of SGD steps, consumed by a ``lax.while_loop`` with a
+  traced bound so every rung of the budget ladder shares one compilation.
+
+The dataset is synthetic CIFAR-like data (class-template images + noise):
+the sandbox has no network, and HPO benchmarking needs a *deterministic,
+learnable* objective, not ImageNet accuracy (SURVEY.md §4's determinism
+note; reference analog: hpbandster/examples example_5 MNIST workers, where
+budget = epochs).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from hpbandster_tpu.space import ConfigurationSpace, UniformFloatHyperparameter
+
+__all__ = [
+    "CNNConfig",
+    "cnn_space",
+    "decode_cnn_hparams",
+    "init_cnn_params",
+    "cnn_forward",
+    "make_image_dataset",
+    "make_cnn_eval_fn",
+    "momentum_sgd_train",
+]
+
+
+class CNNConfig(NamedTuple):
+    image_size: int = 32
+    channels: int = 3
+    width: int = 32          # channels after the stem; doubles once
+    n_classes: int = 10
+    n_train: int = 512
+    n_val: int = 256
+    batch_size: int = 128
+
+
+def cnn_space(seed=None) -> ConfigurationSpace:
+    """lr (log), momentum, weight decay (log), init scale (log)."""
+    cs = ConfigurationSpace(seed=seed)
+    cs.add_hyperparameter(UniformFloatHyperparameter("lr", 1e-4, 1.0, log=True))
+    cs.add_hyperparameter(UniformFloatHyperparameter("momentum", 0.0, 0.99))
+    cs.add_hyperparameter(
+        UniformFloatHyperparameter("weight_decay", 1e-7, 1e-2, log=True)
+    )
+    cs.add_hyperparameter(
+        UniformFloatHyperparameter("init_scale", 0.1, 10.0, log=True)
+    )
+    return cs
+
+
+def decode_cnn_hparams(vec: jax.Array):
+    """Unit-cube vector -> (lr, momentum, weight_decay, init_scale).
+
+    Mirrors ``cnn_space()``'s codec (log ranges) so host dicts and device
+    vectors decode identically.
+    """
+    lr = 10.0 ** (-4.0 + 4.0 * vec[0])
+    momentum = 0.99 * vec[1]
+    wd = 10.0 ** (-7.0 + 5.0 * vec[2])
+    init_scale = 10.0 ** (-1.0 + 2.0 * vec[3])
+    return lr, momentum, wd, init_scale
+
+
+def _conv_init(key, kh, kw, c_in, c_out, scale):
+    fan_in = kh * kw * c_in
+    w = scale * (2.0 / fan_in) ** 0.5 * jax.random.normal(key, (kh, kw, c_in, c_out))
+    return w.astype(jnp.float32)
+
+
+def init_cnn_params(key: jax.Array, cfg: CNNConfig, init_scale) -> dict:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    w, c = cfg.width, cfg.channels
+    # two conv blocks (stride-2 pooling between), then GAP + linear head
+    head_in = 2 * w
+    return {
+        "c1": _conv_init(k1, 3, 3, c, w, init_scale),
+        "b1": jnp.zeros((w,), jnp.float32),
+        "c2": _conv_init(k2, 3, 3, w, 2 * w, init_scale),
+        "b2": jnp.zeros((2 * w,), jnp.float32),
+        "c3": _conv_init(k3, 3, 3, 2 * w, 2 * w, init_scale),
+        "b3": jnp.zeros((2 * w,), jnp.float32),
+        "wh": (
+            init_scale
+            * (2.0 / head_in) ** 0.5
+            * jax.random.normal(k4, (head_in, cfg.n_classes))
+        ).astype(jnp.float32),
+        "bh": jnp.zeros((cfg.n_classes,), jnp.float32),
+    }
+
+
+def _conv(x, w, stride=1):
+    # bf16 operands and output, cast back up: the transpose (grad) conv then
+    # also runs fully in bf16; XLA's TPU lowering accumulates bf16 convs in
+    # f32 on the MXU regardless of the declared output dtype
+    out = jax.lax.conv_general_dilated(
+        x.astype(jnp.bfloat16),
+        w.astype(jnp.bfloat16),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return out.astype(jnp.float32)
+
+
+def cnn_forward(params: dict, x: jax.Array) -> jax.Array:
+    """x: [N, H, W, C] float32 -> logits [N, n_classes]."""
+    h = jax.nn.relu(_conv(x, params["c1"]) + params["b1"])
+    h = jax.nn.relu(_conv(h, params["c2"], stride=2) + params["b2"])
+    h = jax.nn.relu(_conv(h, params["c3"], stride=2) + params["b3"])
+    h = h.mean(axis=(1, 2))  # global average pool
+    head = h.astype(jnp.bfloat16) @ params["wh"].astype(jnp.bfloat16)
+    return head.astype(jnp.float32) + params["bh"]
+
+
+def _xent(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+
+
+def make_image_dataset(key: jax.Array, cfg: CNNConfig):
+    """Class-template images + noise: deterministic, learnable, CIFAR-shaped.
+
+    Each class has a fixed low-frequency template; samples are template +
+    Gaussian noise, so a conv net separates them but must actually train.
+    """
+    kc, kx, kv = jax.random.split(key, 3)
+    s, c = cfg.image_size, cfg.channels
+    # low-frequency templates: upsample small random grids
+    coarse = jax.random.normal(kc, (cfg.n_classes, 4, 4, c))
+    templates = jax.image.resize(coarse, (cfg.n_classes, s, s, c), "linear")
+
+    def draw(k, n):
+        k1, k2 = jax.random.split(k)
+        labels = jax.random.randint(k1, (n,), 0, cfg.n_classes)
+        x = templates[labels] + 1.0 * jax.random.normal(k2, (n, s, s, c))
+        return x.astype(jnp.float32), labels
+
+    return draw(kx, cfg.n_train), draw(kv, cfg.n_val)
+
+
+def momentum_sgd_train(params, lr, momentum, wd, train, budget, loss_fn,
+                       batch_size, n_train):
+    """Momentum-SGD minibatch training under a traced-budget while_loop.
+
+    Shared by every image workload (CNN, ResNet): ``loss_fn(params, xb, yb)``
+    is the per-batch objective; ``budget`` is a traced step count, so one
+    compilation serves the whole budget ladder. Returns the trained params.
+    """
+    x_tr, y_tr = train
+    n_batches = max(n_train // batch_size, 1)
+    grad_fn = jax.grad(loss_fn)
+    velocity = jax.tree.map(jnp.zeros_like, params)
+
+    def body(state):
+        step, p, v = state
+        start = (step % n_batches) * batch_size
+        xb = jax.lax.dynamic_slice_in_dim(x_tr, start, batch_size)
+        yb = jax.lax.dynamic_slice_in_dim(y_tr, start, batch_size)
+        g = grad_fn(p, xb, yb)
+        v = jax.tree.map(lambda vi, gi, pi: momentum * vi + gi + wd * pi, v, g, p)
+        p = jax.tree.map(lambda pi, vi: pi - lr * vi, p, v)
+        return step + 1, p, v
+
+    def cond(state):
+        return state[0] < budget.astype(jnp.int32)
+
+    _, params, _ = jax.lax.while_loop(cond, body, (jnp.int32(0), params, velocity))
+    return params
+
+
+def _train_loop(params, hp, train, val, budget, cfg: CNNConfig):
+    lr, momentum, wd, _ = hp
+
+    def loss_fn(p, xb, yb):
+        return _xent(cnn_forward(p, xb), yb)
+
+    params = momentum_sgd_train(
+        params, lr, momentum, wd, train, budget, loss_fn,
+        cfg.batch_size, cfg.n_train,
+    )
+    x_v, y_v = val
+    return _xent(cnn_forward(params, x_v), y_v)
+
+
+def make_cnn_eval_fn(cfg: CNNConfig = CNNConfig(), data_seed: int = 0):
+    """Build ``eval_fn(config_vec, budget) -> val_loss`` for VmapBackend.
+
+    Dataset and init key are fixed (closed over) so the objective is
+    deterministic per config; budget = SGD steps.
+    """
+    train, val = make_image_dataset(jax.random.key(data_seed), cfg)
+    init_key = jax.random.key(data_seed + 1)
+
+    def eval_fn(vec: jax.Array, budget) -> jax.Array:
+        hp = decode_cnn_hparams(vec)
+        params = init_cnn_params(init_key, cfg, hp[3])
+        budget_arr = jnp.asarray(budget, jnp.float32)
+        return _train_loop(params, hp, train, val, budget_arr, cfg)
+
+    return eval_fn
