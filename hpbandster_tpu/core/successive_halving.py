@@ -14,7 +14,7 @@ import numpy as np
 
 from hpbandster_tpu.core.iteration import BaseIteration
 from hpbandster_tpu.core.job import ConfigId
-from hpbandster_tpu.ops.bracket import sh_promotion_mask
+from hpbandster_tpu.ops.bracket import sh_promotion_mask_np
 
 __all__ = ["SuccessiveHalving", "SuccessiveResampling"]
 
@@ -26,7 +26,7 @@ class SuccessiveHalving(BaseIteration):
         self, config_ids: List[ConfigId], losses: np.ndarray
     ) -> np.ndarray:
         k = self.num_configs[self.stage + 1]
-        return np.asarray(sh_promotion_mask(losses.astype(np.float32), k))
+        return sh_promotion_mask_np(losses, k)
 
 
 class SuccessiveResampling(BaseIteration):
@@ -51,6 +51,4 @@ class SuccessiveResampling(BaseIteration):
         )
         # the unfilled remainder of the next stage is topped up by
         # get_next_run() sampling fresh configs (actual_num_configs < quota)
-        return np.asarray(
-            sh_promotion_mask(losses.astype(np.float32), min(n_promote, k))
-        )
+        return sh_promotion_mask_np(losses, min(n_promote, k))

@@ -95,8 +95,12 @@ class BOHBKDE(base_config_generator):
             min_points_in_model = d + 1
         self.min_points_in_model = int(min_points_in_model)
 
-        self.vartypes = jnp.asarray(configspace.vartypes())
-        self.cards = jnp.asarray(configspace.cardinalities())
+        # host copies for numpy bookkeeping (imputation, bandwidth caps) and
+        # device copies for the proposal kernels — each converted exactly once
+        self.vartypes = np.asarray(configspace.vartypes())
+        self.cards = np.asarray(configspace.cardinalities())
+        self._vartypes_dev = jnp.asarray(self.vartypes)
+        self._cards_dev = jnp.asarray(self.cards)
 
         self.rng = np.random.default_rng(seed)
         self.key = jax.random.key(seed if seed is not None else 0)
@@ -210,11 +214,11 @@ class BOHBKDE(base_config_generator):
         from hpbandster_tpu.ops.pallas_kde import pallas_available
 
         cands = generate_candidates_seeded(
-            seed, good, self.vartypes, self.cards, n, self.num_samples,
+            seed, good, self._vartypes_dev, self._cards_dev, n, self.num_samples,
             self.bandwidth_factor, self.min_bandwidth,
         )
         scores = pallas_score_candidates(
-            cands, good, bad, self.vartypes, self.cards,
+            cands, good, bad, self._vartypes_dev, self._cards_dev,
             interpret=not pallas_available(),  # CPU tests run interpreted
         )
         scores = np.asarray(scores).reshape(n, self.num_samples)
@@ -270,8 +274,8 @@ class BOHBKDE(base_config_generator):
                 self._next_key(),
                 good,
                 bad,
-                self.vartypes,
-                self.cards,
+                self._vartypes_dev,
+                self._cards_dev,
                 self.num_samples,
                 self.bandwidth_factor,
                 self.min_bandwidth,
@@ -315,8 +319,8 @@ class BOHBKDE(base_config_generator):
                         seed,
                         good,
                         bad,
-                        self.vartypes,
-                        self.cards,
+                        self._vartypes_dev,
+                        self._cards_dev,
                         n_pad,
                         self.num_samples,
                         self.bandwidth_factor,

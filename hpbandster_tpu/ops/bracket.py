@@ -24,6 +24,7 @@ __all__ = [
     "hyperband_bracket",
     "hyperband_schedule",
     "sh_promotion_mask",
+    "sh_promotion_mask_np",
     "sh_resample_mask",
 ]
 
@@ -111,6 +112,24 @@ def sh_promotion_mask(losses: jax.Array, k) -> jax.Array:
     losses = jnp.asarray(losses)
     clean = jnp.where(jnp.isnan(losses), jnp.inf, losses)
     ranks = jnp.argsort(jnp.argsort(clean))
+    return ranks < k
+
+
+def sh_promotion_mask_np(losses: np.ndarray, k) -> np.ndarray:
+    """Host (numpy) twin of :func:`sh_promotion_mask` — bit-identical
+    semantics (NaN -> +inf, stable double-argsort ranking, rank < k).
+
+    The Master's per-stage bookkeeping runs over a few dozen host floats; a
+    device dispatch there costs a full accelerator round-trip (tens of ms
+    over a tunneled link) to rank an 81-element array. The jittable version
+    stays the on-device rule inside fused brackets and vmapped sweeps.
+    """
+    # rank in float32, same as the device twin — float64 here would break
+    # tie-handling parity with the fused on-device bracket on near-equal
+    # losses (distinct in f64, tied after f32 rounding)
+    losses = np.asarray(losses, dtype=np.float32)
+    clean = np.where(np.isnan(losses), np.float32(np.inf), losses)
+    ranks = np.argsort(np.argsort(clean, kind="stable"), kind="stable")
     return ranks < k
 
 

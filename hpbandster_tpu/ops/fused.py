@@ -96,8 +96,11 @@ def _unpack_stages(packed, num_configs):
 
 #: process-wide compiled-bracket cache: optimizer/executor instances come
 #: and go (warmups, repeated runs), but a (objective, bracket shape, mesh)
-#: combination should compile exactly once per process
-_FUSED_FN_CACHE: dict = {}
+#: combination should compile exactly once per process. Bounded so misses
+#: from throwaway closures cannot pin datasets/executables forever.
+from hpbandster_tpu.utils.lru import LRUCache as _LRUCache
+
+_FUSED_FN_CACHE: _LRUCache = _LRUCache(maxsize=64)
 
 
 def make_fused_bracket_fn(

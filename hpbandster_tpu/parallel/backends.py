@@ -16,7 +16,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from hpbandster_tpu.utils.lru import LRUCache
+
 __all__ = ["VmapBackend"]
+
+#: process-wide compiled-batch cache: backend instances come and go
+#: (warmups, repeated optimizer runs), but an (objective, batch shape,
+#: budget-mode, mesh) combination should compile exactly once per process —
+#: same policy as ops.fused._FUSED_FN_CACHE. Hits require the SAME eval_fn
+#: object (rebuild closures once, not per optimizer run); bounded LRU so
+#: misses from throwaway closures cannot pin their captured datasets and
+#: compiled executables forever.
+_BATCH_FN_CACHE: LRUCache = LRUCache(maxsize=64)
 
 
 class VmapBackend:
@@ -49,7 +60,7 @@ class VmapBackend:
         self.axis = axis
         self.static_budget = bool(static_budget)
         self.min_pad = int(min_pad)
-        self._compiled: Dict[Any, Callable] = {}
+        self._compiled = _BATCH_FN_CACHE
 
     # ------------------------------------------------------------------ info
     @property
@@ -91,7 +102,14 @@ class VmapBackend:
         vectors = np.asarray(vectors, np.float32)
         n, d = vectors.shape
         n_pad = self._padded_size(n)
-        key = (n_pad, d, float(budget) if self.static_budget else None)
+        key = (
+            self.eval_fn,
+            n_pad,
+            d,
+            float(budget) if self.static_budget else None,
+            self.mesh,
+            self.axis,
+        )
         if key not in self._compiled:
             self._compiled[key] = self._build(
                 n_pad, float(budget) if self.static_budget else None

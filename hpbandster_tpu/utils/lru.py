@@ -1,0 +1,47 @@
+"""Tiny bounded LRU mapping for process-wide compile caches."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any
+
+__all__ = ["LRUCache"]
+
+
+class LRUCache:
+    """Dict-shaped LRU: reads refresh recency, inserts evict the oldest.
+
+    Used for process-wide compiled-function caches, where an unbounded dict
+    would pin every closed-over dataset and XLA executable for the process
+    lifetime while throwaway closures (new identity each call) never hit.
+    """
+
+    def __init__(self, maxsize: int = 64):
+        self.maxsize = int(maxsize)
+        self._data: OrderedDict = OrderedDict()
+
+    def __contains__(self, key: Any) -> bool:
+        return key in self._data
+
+    def __getitem__(self, key: Any) -> Any:
+        value = self._data[key]
+        self._data.move_to_end(key)
+        return value
+
+    def get(self, key: Any, default: Any = None) -> Any:
+        if key in self._data:
+            return self[key]
+        return default
+
+    def __setitem__(self, key: Any, value: Any) -> None:
+        if key in self._data:
+            self._data.move_to_end(key)
+        self._data[key] = value
+        while len(self._data) > self.maxsize:
+            self._data.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def clear(self) -> None:
+        self._data.clear()

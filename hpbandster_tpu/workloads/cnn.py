@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from hpbandster_tpu.space import ConfigurationSpace, UniformFloatHyperparameter
+from hpbandster_tpu.workloads.train import momentum_sgd_train
 
 __all__ = [
     "CNNConfig",
@@ -154,36 +155,6 @@ def make_image_dataset(key: jax.Array, cfg: CNNConfig):
         return x.astype(jnp.float32), labels
 
     return draw(kx, cfg.n_train), draw(kv, cfg.n_val)
-
-
-def momentum_sgd_train(params, lr, momentum, wd, train, budget, loss_fn,
-                       batch_size, n_train):
-    """Momentum-SGD minibatch training under a traced-budget while_loop.
-
-    Shared by every image workload (CNN, ResNet): ``loss_fn(params, xb, yb)``
-    is the per-batch objective; ``budget`` is a traced step count, so one
-    compilation serves the whole budget ladder. Returns the trained params.
-    """
-    x_tr, y_tr = train
-    n_batches = max(n_train // batch_size, 1)
-    grad_fn = jax.grad(loss_fn)
-    velocity = jax.tree.map(jnp.zeros_like, params)
-
-    def body(state):
-        step, p, v = state
-        start = (step % n_batches) * batch_size
-        xb = jax.lax.dynamic_slice_in_dim(x_tr, start, batch_size)
-        yb = jax.lax.dynamic_slice_in_dim(y_tr, start, batch_size)
-        g = grad_fn(p, xb, yb)
-        v = jax.tree.map(lambda vi, gi, pi: momentum * vi + gi + wd * pi, v, g, p)
-        p = jax.tree.map(lambda pi, vi: pi - lr * vi, p, v)
-        return step + 1, p, v
-
-    def cond(state):
-        return state[0] < budget.astype(jnp.int32)
-
-    _, params, _ = jax.lax.while_loop(cond, body, (jnp.int32(0), params, velocity))
-    return params
 
 
 def _train_loop(params, hp, train, val, budget, cfg: CNNConfig):

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from hpbandster_tpu.space import ConfigurationSpace, UniformFloatHyperparameter
+from hpbandster_tpu.workloads.train import momentum_sgd_train
 
 __all__ = [
     "mlp_space",
@@ -115,29 +116,14 @@ def make_synthetic_dataset(key: jax.Array, cfg: MLPConfig):
 
 def _train_loop(params, hp, train, val, budget, cfg: MLPConfig):
     lr, momentum, wd, _ = hp
-    x_tr, y_tr = train
-    n_batches = max(cfg.n_train // cfg.batch_size, 1)
 
     def loss_fn(p, xb, yb):
         return _xent(mlp_forward(p, xb), yb)
 
-    grad_fn = jax.grad(loss_fn)
-    velocity = jax.tree.map(jnp.zeros_like, params)
-
-    def body(state):
-        step, p, v = state
-        start = (step % n_batches) * cfg.batch_size
-        xb = jax.lax.dynamic_slice_in_dim(x_tr, start, cfg.batch_size)
-        yb = jax.lax.dynamic_slice_in_dim(y_tr, start, cfg.batch_size)
-        g = grad_fn(p, xb, yb)
-        v = jax.tree.map(lambda vi, gi, pi: momentum * vi + gi + wd * pi, v, g, p)
-        p = jax.tree.map(lambda pi, vi: pi - lr * vi, p, v)
-        return step + 1, p, v
-
-    def cond(state):
-        return state[0] < budget.astype(jnp.int32)
-
-    _, params, _ = jax.lax.while_loop(cond, body, (jnp.int32(0), params, velocity))
+    params = momentum_sgd_train(
+        params, lr, momentum, wd, train, budget, loss_fn,
+        cfg.batch_size, cfg.n_train,
+    )
     x_v, y_v = val
     return _xent(mlp_forward(params, x_v), y_v)
 

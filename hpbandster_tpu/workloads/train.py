@@ -1,0 +1,45 @@
+"""Shared batched-training primitive for the built-in workloads.
+
+One momentum-SGD minibatch loop under a traced-budget ``lax.while_loop``
+serves the MLP, CNN and ResNet workloads (budget = step count; one
+compilation covers a whole SH budget ladder).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["momentum_sgd_train"]
+
+
+def momentum_sgd_train(params, lr, momentum, wd, train, budget, loss_fn,
+                       batch_size, n_train):
+    """Train ``params`` with momentum SGD for ``budget`` (traced) steps.
+
+    ``loss_fn(params, xb, yb)`` is the per-batch objective; minibatches
+    cycle through ``train = (x, y)`` by dynamic slicing. ``batch_size`` is
+    clamped to the dataset size — a larger request would be an XLA trace
+    error deep inside the batched dispatch, opaque to the caller.
+    """
+    x_tr, y_tr = train
+    batch_size = min(int(batch_size), int(n_train))
+    n_batches = max(n_train // batch_size, 1)
+    grad_fn = jax.grad(loss_fn)
+    velocity = jax.tree.map(jnp.zeros_like, params)
+
+    def body(state):
+        step, p, v = state
+        start = (step % n_batches) * batch_size
+        xb = jax.lax.dynamic_slice_in_dim(x_tr, start, batch_size)
+        yb = jax.lax.dynamic_slice_in_dim(y_tr, start, batch_size)
+        g = grad_fn(p, xb, yb)
+        v = jax.tree.map(lambda vi, gi, pi: momentum * vi + gi + wd * pi, v, g, p)
+        p = jax.tree.map(lambda pi, vi: pi - lr * vi, p, v)
+        return step + 1, p, v
+
+    def cond(state):
+        return state[0] < budget.astype(jnp.int32)
+
+    _, params, _ = jax.lax.while_loop(cond, body, (jnp.int32(0), params, velocity))
+    return params
