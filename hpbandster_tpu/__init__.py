@@ -29,6 +29,9 @@ _EXPORTS = {
     "logged_results_to_HBS_result": "hpbandster_tpu.core.result",
     "Worker": "hpbandster_tpu.core.worker",
     "NameServer": "hpbandster_tpu.core.nameserver",
+    "TPUBatchedWorker": "hpbandster_tpu.parallel.batched_worker",
+    "RPCBatchBackend": "hpbandster_tpu.parallel.batched_worker",
+    "JaxSuccessiveHalving": "hpbandster_tpu.core.successive_halving",
     "BOHB": "hpbandster_tpu.optimizers",
     "HyperBand": "hpbandster_tpu.optimizers",
     "RandomSearch": "hpbandster_tpu.optimizers",

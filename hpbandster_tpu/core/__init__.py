@@ -3,6 +3,7 @@
 from hpbandster_tpu.core.job import Job  # noqa: F401
 from hpbandster_tpu.core.iteration import BaseIteration, Datum, Status  # noqa: F401
 from hpbandster_tpu.core.successive_halving import (  # noqa: F401
+    JaxSuccessiveHalving,
     SuccessiveHalving,
     SuccessiveResampling,
 )

@@ -16,7 +16,7 @@ from hpbandster_tpu.core.iteration import BaseIteration
 from hpbandster_tpu.core.job import ConfigId
 from hpbandster_tpu.ops.bracket import sh_promotion_mask_np
 
-__all__ = ["SuccessiveHalving", "SuccessiveResampling"]
+__all__ = ["SuccessiveHalving", "SuccessiveResampling", "JaxSuccessiveHalving"]
 
 
 class SuccessiveHalving(BaseIteration):
@@ -52,3 +52,35 @@ class SuccessiveResampling(BaseIteration):
         # the unfilled remainder of the next stage is topped up by
         # get_next_run() sampling fresh configs (actual_num_configs < quota)
         return sh_promotion_mask_np(losses, min(n_promote, k))
+
+
+class JaxSuccessiveHalving(SuccessiveHalving):
+    """SuccessiveHalving whose promotion mask is decided on-device.
+
+    The per-bracket allocation (the top-k ranking) runs as the jitted
+    ``ops.bracket.sh_promotion_mask`` kernel instead of host numpy — the
+    "per-bracket allocation decided on-device" half of the north star. The
+    kernel is bit-identical to the host rule (same NaN -> +inf, f32
+    double-argsort ranking), so fused-bracket caches and host bookkeeping
+    always agree; use this iteration type when the Master itself runs
+    colocated with the accelerator (e.g. ``BOHB(..., iteration_class=
+    JaxSuccessiveHalving)``) and the loss vector is already device-resident.
+    """
+
+    _jitted = None
+
+    def _advance_to_next_stage(
+        self, config_ids: List[ConfigId], losses: np.ndarray
+    ) -> np.ndarray:
+        import jax
+        import jax.numpy as jnp
+
+        from hpbandster_tpu.ops.bracket import sh_promotion_mask
+
+        if JaxSuccessiveHalving._jitted is None:
+            JaxSuccessiveHalving._jitted = jax.jit(sh_promotion_mask)
+        k = self.num_configs[self.stage + 1]
+        mask = JaxSuccessiveHalving._jitted(
+            jnp.asarray(losses, jnp.float32), jnp.asarray(k, jnp.int32)
+        )
+        return np.asarray(mask)

@@ -91,6 +91,7 @@ class Worker:
         self._server.register("is_busy", self._rpc_is_busy)
         self._server.register("shutdown", self._rpc_shutdown)
         self._server.register("ping", lambda: "pong")
+        self._extra_rpc(self._server)
         self._server.start()
 
         ns = RPCProxy(f"{self.nameserver}:{self.nameserver_port}")
@@ -135,6 +136,10 @@ class Worker:
             threading.Thread(target=self._teardown, daemon=True).start()
 
     # ------------------------------------------------------------ rpc surface
+    def _extra_rpc(self, server: RPCServer) -> None:
+        """Hook for subclasses to register additional RPC methods before the
+        server starts (e.g. TPUBatchedWorker's ``evaluate_batch``)."""
+
     def _rpc_is_busy(self) -> bool:
         return self._busy_lock.locked()
 

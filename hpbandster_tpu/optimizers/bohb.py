@@ -33,6 +33,7 @@ class BOHB(Master):
         bandwidth_factor: float = 3.0,
         min_bandwidth: float = 1e-3,
         seed: Optional[int] = None,
+        iteration_class: type = SuccessiveHalving,
         **kwargs: Any,
     ):
         if configspace is None:
@@ -48,6 +49,7 @@ class BOHB(Master):
             seed=seed,
         )
         super().__init__(config_generator=cg, **kwargs)
+        self.iteration_class = iteration_class
 
         self.configspace = configspace
         self.eta = float(eta)
@@ -76,7 +78,7 @@ class BOHB(Master):
         self, iteration: int, iteration_kwargs: Dict[str, Any]
     ) -> SuccessiveHalving:
         plan = hyperband_bracket(iteration, self.min_budget, self.max_budget, self.eta)
-        return SuccessiveHalving(
+        return self.iteration_class(
             HPB_iter=iteration,
             num_configs=list(plan.num_configs),
             budgets=list(plan.budgets),

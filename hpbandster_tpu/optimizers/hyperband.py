@@ -27,12 +27,14 @@ class HyperBand(Master):
         min_budget: float = 0.01,
         max_budget: float = 1,
         seed: Optional[int] = None,
+        iteration_class: type = SuccessiveHalving,
         **kwargs: Any,
     ):
         if configspace is None:
             raise ValueError("you have to provide a valid ConfigurationSpace object")
         cg = RandomSampling(configspace, seed=seed)
         super().__init__(config_generator=cg, **kwargs)
+        self.iteration_class = iteration_class
 
         self.configspace = configspace
         self.eta = float(eta)
@@ -55,7 +57,7 @@ class HyperBand(Master):
         self, iteration: int, iteration_kwargs: Dict[str, Any]
     ) -> SuccessiveHalving:
         plan = hyperband_bracket(iteration, self.min_budget, self.max_budget, self.eta)
-        return SuccessiveHalving(
+        return self.iteration_class(
             HPB_iter=iteration,
             num_configs=list(plan.num_configs),
             budgets=list(plan.budgets),

@@ -13,6 +13,10 @@ from hpbandster_tpu.parallel.mesh import (  # noqa: F401
 )
 from hpbandster_tpu.parallel.backends import VmapBackend  # noqa: F401
 from hpbandster_tpu.parallel.batched_executor import BatchedExecutor  # noqa: F401
+from hpbandster_tpu.parallel.batched_worker import (  # noqa: F401
+    RPCBatchBackend,
+    TPUBatchedWorker,
+)
 from hpbandster_tpu.parallel.dispatcher import Dispatcher  # noqa: F401
 from hpbandster_tpu.parallel.rpc import (  # noqa: F401
     CommunicationError,

@@ -110,13 +110,13 @@ class VmapBackend:
             self.mesh,
             self.axis,
         )
-        if key not in self._compiled:
-            self._compiled[key] = self._build(
-                n_pad, float(budget) if self.static_budget else None
-            )
+        # fetch-then-call on a local ref: the shared LRU may evict the entry
+        # between a membership check and the call under concurrent waves
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._build(n_pad, float(budget) if self.static_budget else None)
+            self._compiled[key] = fn
         padded = np.zeros((n_pad, d), np.float32)
         padded[:n] = vectors
-        losses = self._compiled[key](
-            jnp.asarray(padded), jnp.float32(budget)
-        )
+        losses = fn(jnp.asarray(padded), jnp.float32(budget))
         return np.asarray(losses)[:n]

@@ -1,0 +1,371 @@
+"""TPUBatchedWorker + RPCBatchBackend — the multi-host batched tier.
+
+This is the bridge named in the north star (SURVEY.md §0 / §2 "Task
+parallel" row): where the reference evaluates strictly one config per
+worker per RPC round-trip, a ``TPUBatchedWorker`` is one host process per
+TPU slice that evaluates a whole *vector* of configurations per job — the
+batch runs as a single sharded XLA dispatch on the worker's local mesh, and
+only the loss vector rides the (DCN-tier) RPC link back.
+
+Two halves:
+
+* :class:`TPUBatchedWorker` — a :class:`~hpbandster_tpu.core.worker.Worker`
+  subclass that owns a :class:`~hpbandster_tpu.parallel.backends.VmapBackend`
+  over its local devices and exposes an ``evaluate_batch`` RPC. It remains
+  fully compatible with the plain dispatcher: single-config jobs submitted
+  through ``start_computation`` are evaluated as a batch of one, so a pool
+  may mix CPU dict-workers and TPU batched workers behind one nameserver.
+* :class:`RPCBatchBackend` — the master-side counterpart implementing the
+  same ``evaluate(vectors, budget) -> losses`` protocol as ``VmapBackend``,
+  so it plugs straight into ``BatchedExecutor`` (stage batching, bracket
+  interleaving, crashed-as-NaN semantics all carry over). Each wave is
+  split across the registered batched workers proportional to their device
+  counts; worker death mid-wave retries the shard on the survivors and only
+  NaN-fills when nobody is left (the reference's elastic requeue behavior,
+  SURVEY.md §5, lifted to shard granularity).
+
+Elasticity note: each worker's mesh is local to its process, so workers can
+join/leave between waves without any global SPMD membership change — the
+SURVEY §7 "confine elasticity to the host tier" rule.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from hpbandster_tpu.core.worker import Worker
+from hpbandster_tpu.parallel.rpc import CommunicationError, RPCError, RPCProxy, RPCServer
+
+__all__ = ["TPUBatchedWorker", "RPCBatchBackend"]
+
+
+class TPUBatchedWorker(Worker):
+    """A worker that evaluates a vector of configs per job on local devices.
+
+    ``eval_fn(config_vector: f32[d], budget: f32[]) -> loss: f32[]`` must be
+    jittable (same contract as ``VmapBackend``). ``configspace`` supplies the
+    dict -> unit-hypercube codec for single-config (plain dispatcher) jobs.
+
+    By default the backend shards each batch over ALL local devices with a
+    1-D ``('config',)`` mesh; pass ``mesh=`` to control placement (e.g. a
+    ('config', 'model') mesh where each config's training step is itself
+    tensor-parallel) or ``mesh=None, devices=1`` for single-device tests.
+    """
+
+    def __init__(
+        self,
+        run_id: str,
+        eval_fn: Callable,
+        configspace=None,
+        mesh: Any = "auto",
+        static_budget: bool = False,
+        min_pad: int = 8,
+        **worker_kwargs: Any,
+    ):
+        super().__init__(run_id, **worker_kwargs)
+        from hpbandster_tpu.parallel.backends import VmapBackend
+
+        if mesh == "auto":
+            import jax
+
+            devices = jax.devices()
+            if len(devices) > 1:
+                from hpbandster_tpu.parallel.mesh import config_mesh
+
+                mesh = config_mesh(devices)
+            else:
+                mesh = None
+        self.configspace = configspace
+        self.backend = VmapBackend(
+            eval_fn, mesh=mesh, static_budget=static_budget, min_pad=min_pad
+        )
+
+    # ------------------------------------------------------------ rpc surface
+    def _extra_rpc(self, server: RPCServer) -> None:
+        server.register("evaluate_batch", self._rpc_evaluate_batch)
+        server.register("capabilities", self._rpc_capabilities)
+
+    def _rpc_capabilities(self) -> Dict[str, Any]:
+        return {"batch": True, "devices": int(self.backend.parallelism)}
+
+    def _rpc_evaluate_batch(
+        self, vectors: List[List[float]], budget: float
+    ) -> Dict[str, Any]:
+        """One wave: ``f32[n, d]`` unit-hypercube vectors -> ``f32[n]`` losses.
+
+        Per-config crashes surface as non-finite losses (the caller maps
+        them to crashed jobs); a backend-level failure raises and is
+        marshalled back as an RPCError for the master to retry elsewhere.
+        Holds the busy lock for the duration: concurrent waves serialize on
+        the local devices, the dispatcher's ``is_busy`` probe reports the
+        truth, and the idle-timeout watchdog cannot fire mid-evaluation.
+        """
+        arr = np.asarray(vectors, dtype=np.float32)
+        if arr.ndim != 2:
+            raise ValueError(f"vectors must be [n, d], got shape {arr.shape}")
+        with self._busy_lock:
+            self._last_active = time.time()
+            t0 = time.perf_counter()
+            losses = self.backend.evaluate(arr, float(budget))
+            self.logger.debug(
+                "evaluate_batch: %d configs at budget %g in %.3fs",
+                len(arr), budget, time.perf_counter() - t0,
+            )
+            self._last_active = time.time()
+        # stdlib json round-trips NaN/Infinity literals exactly, so crashed
+        # (NaN) and diverged (+/-inf) losses survive the wire unchanged and
+        # both backends agree on identical inputs
+        return {"losses": [float(x) for x in losses]}
+
+    # --------------------------------------------------------------- user API
+    def compute(
+        self,
+        config_id: Any,
+        config: Dict[str, Any],
+        budget: float,
+        working_directory: str,
+    ) -> Dict[str, Any]:
+        """Plain-dispatcher compatibility: one config = a batch of one."""
+        if self.configspace is None:
+            raise RuntimeError(
+                "single-config jobs need configspace= for the dict->vector codec"
+            )
+        vec = np.nan_to_num(
+            self.configspace.to_vector(config), nan=0.0
+        ).astype(np.float32)
+        loss = float(self.backend.evaluate(vec[None, :], float(budget))[0])
+        if not np.isfinite(loss):
+            raise RuntimeError(f"non-finite loss {loss!r} at budget {budget}")
+        return {"loss": loss, "info": {"batched": True}}
+
+
+class _BatchWorkerProxy:
+    """Master-side record of one batched worker."""
+
+    def __init__(self, name: str, uri: str, devices: int):
+        self.name = name
+        self.uri = uri
+        self.devices = max(int(devices), 1)
+
+    def evaluate(self, vectors: np.ndarray, budget: float, timeout: float) -> np.ndarray:
+        reply = RPCProxy(self.uri, timeout=timeout).call(
+            "evaluate_batch",
+            vectors=[[float(x) for x in row] for row in vectors],
+            budget=float(budget),
+        )
+        # None tolerated defensively for non-stdlib peers that cannot emit
+        # NaN/Infinity literals
+        losses = np.array(
+            [np.nan if x is None else x for x in reply["losses"]], dtype=np.float32
+        )
+        if losses.shape != (len(vectors),):
+            raise CommunicationError(
+                f"worker {self.name} returned {losses.shape[0]} losses for "
+                f"{len(vectors)} configs"
+            )
+        return losses
+
+
+class RPCBatchBackend:
+    """``evaluate(vectors, budget) -> losses`` over a pool of batched workers.
+
+    Discovery mirrors the dispatcher (SURVEY.md §2 "Dispatcher" row): the
+    nameserver is polled for ``hpbandster.run_<id>.worker.*`` registrations
+    and each candidate is probed once for the ``capabilities`` RPC — only
+    batch-capable workers join the pool, so plain dict-workers behind the
+    same nameserver are simply ignored. Waves are split proportionally to
+    per-worker device counts and issued concurrently; a failed shard is
+    retried on the surviving workers before NaN-filling.
+    """
+
+    def __init__(
+        self,
+        run_id: str,
+        nameserver: str,
+        nameserver_port: int,
+        logger: Optional[logging.Logger] = None,
+        rpc_timeout: float = 600.0,
+        refresh_interval: float = 1.0,
+        max_retries: int = 2,
+    ):
+        self.run_id = run_id
+        self.nameserver = nameserver
+        self.nameserver_port = nameserver_port
+        self.logger = logger or logging.getLogger("hpbandster_tpu.rpc_batch_backend")
+        self.rpc_timeout = float(rpc_timeout)
+        self.refresh_interval = float(refresh_interval)
+        self.max_retries = int(max_retries)
+        self._workers: Dict[str, _BatchWorkerProxy] = {}
+        self._probed_not_batch: set = set()
+        self._last_refresh = 0.0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- discovery
+    @property
+    def _prefix(self) -> str:
+        return f"hpbandster.run_{self.run_id}.worker."
+
+    def refresh_workers(self, force: bool = False) -> None:
+        now = time.time()
+        if not force and now - self._last_refresh < self.refresh_interval:
+            return
+        try:
+            listing = RPCProxy(
+                f"{self.nameserver}:{self.nameserver_port}", timeout=5
+            ).call("list", prefix=self._prefix)
+        except (CommunicationError, RPCError) as e:
+            self.logger.warning("nameserver unreachable: %r", e)
+            return
+        with self._lock:
+            self._last_refresh = now
+            gone = set(self._workers) - set(listing)
+            for name in gone:
+                self.logger.info("batched worker %s left the pool", name)
+                del self._workers[name]
+            to_probe = []
+            for name, uri in listing.items():
+                if name in self._workers:
+                    if self._workers[name].uri != uri:
+                        self._workers[name].uri = uri
+                elif name not in self._probed_not_batch:
+                    to_probe.append((name, uri))
+
+        # probe OUTSIDE the lock and concurrently: one unreachable-but-
+        # registered candidate must not stall the wave (refresh runs on the
+        # evaluate() hot path) nor shard failure handling
+        def probe(name: str, uri: str) -> None:
+            try:
+                caps = RPCProxy(uri, timeout=5).call("capabilities")
+            except RPCError:
+                # a live worker without the method is definitively not
+                # batch-capable — cache the verdict
+                with self._lock:
+                    self._probed_not_batch.add(name)
+                return
+            except (CommunicationError, OSError):
+                # transient (connect timeout, mid-restart): do NOT blacklist,
+                # retry on the next refresh
+                return
+            if not isinstance(caps, dict) or not caps.get("batch"):
+                with self._lock:
+                    self._probed_not_batch.add(name)
+                return
+            proxy = _BatchWorkerProxy(name, uri, caps.get("devices", 1))
+            with self._lock:
+                self._workers[name] = proxy
+            self.logger.info(
+                "batched worker %s joined (%d devices)", name, proxy.devices
+            )
+
+        threads = [
+            threading.Thread(target=probe, args=c, daemon=True) for c in to_probe
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    @property
+    def parallelism(self) -> int:
+        """Total devices across the pool (BatchedExecutor's worker count)."""
+        self.refresh_workers()
+        with self._lock:
+            return sum(w.devices for w in self._workers.values()) or 0
+
+    def wait_for_workers(self, min_n_workers: int = 1, timeout: float = 60.0) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            self.refresh_workers(force=True)
+            with self._lock:
+                if len(self._workers) >= min_n_workers:
+                    return
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"fewer than {min_n_workers} batched workers after {timeout}s"
+        )
+
+    # ------------------------------------------------------------ evaluation
+    @staticmethod
+    def _split(
+        n: int, workers: List[_BatchWorkerProxy]
+    ) -> List[Tuple[_BatchWorkerProxy, int, int]]:
+        """Contiguous shard bounds over ``range(n)``, proportional to device
+        counts — at most ONE shard per worker."""
+        total = sum(w.devices for w in workers)
+        bounds, acc = [], 0
+        for w in workers:
+            share = round(n * w.devices / total)
+            bounds.append((w, acc, min(acc + share, n)))
+            acc = min(acc + share, n)
+        # remainder (rounding) goes to the last worker
+        if bounds and acc < n:
+            w, lo, _ = bounds[-1]
+            bounds[-1] = (w, lo, n)
+        return [(w, lo, hi) for w, lo, hi in bounds if hi > lo]
+
+    def evaluate(self, vectors: np.ndarray, budget: float) -> np.ndarray:
+        vectors = np.asarray(vectors, dtype=np.float32)
+        n = len(vectors)
+        losses = np.full(n, np.nan, dtype=np.float32)
+        #: global indices still awaiting a result; retry rounds re-split this
+        #: merged set so each worker receives at most one RPC per attempt
+        pending = np.arange(n)
+        #: workers that failed DURING this wave: excluded from its retries
+        #: even if a forced refresh re-discovers them (a straggler would just
+        #: block the retry behind its busy lock and time out again)
+        failed_names: set = set()
+
+        for attempt in range(self.max_retries + 1):
+            self.refresh_workers(force=attempt > 0)
+            with self._lock:
+                workers = [
+                    w for w in self._workers.values() if w.name not in failed_names
+                ]
+            if not workers:
+                self.logger.error("no batched workers alive; wave crashes as NaN")
+                break
+
+            shards = [
+                (w, pending[lo:hi])
+                for w, lo, hi in self._split(len(pending), workers)
+            ]
+            failed: List[np.ndarray] = []
+            failed_lock = threading.Lock()
+
+            def run_shard(w: _BatchWorkerProxy, idx: np.ndarray) -> None:
+                # broad catch: a malformed reply (KeyError/TypeError) must
+                # enter the retry path exactly like a vanished peer
+                try:
+                    losses[idx] = w.evaluate(vectors[idx], budget, self.rpc_timeout)
+                except Exception as e:
+                    self.logger.warning(
+                        "shard of %d configs failed on %s: %r", len(idx), w.name, e
+                    )
+                    with failed_lock:
+                        failed.append(idx)
+                        failed_names.add(w.name)
+                    with self._lock:
+                        self._workers.pop(w.name, None)
+
+            threads = [
+                threading.Thread(target=run_shard, args=s, daemon=True)
+                for s in shards
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+            if not failed:
+                return losses
+            pending = np.concatenate(failed)
+            self.logger.info(
+                "retrying %d failed config(s), attempt %d/%d",
+                len(pending), attempt + 1, self.max_retries,
+            )
+        return losses

@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Any
 
@@ -14,34 +15,45 @@ class LRUCache:
     Used for process-wide compiled-function caches, where an unbounded dict
     would pin every closed-over dataset and XLA executable for the process
     lifetime while throwaway closures (new identity each call) never hit.
+    Thread-safe: caches are shared across RPC handler threads (e.g. a
+    TPUBatchedWorker serving concurrent waves).
     """
 
     def __init__(self, maxsize: int = 64):
         self.maxsize = int(maxsize)
         self._data: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
 
     def __contains__(self, key: Any) -> bool:
-        return key in self._data
+        with self._lock:
+            return key in self._data
 
     def __getitem__(self, key: Any) -> Any:
-        value = self._data[key]
-        self._data.move_to_end(key)
-        return value
+        with self._lock:
+            value = self._data[key]
+            self._data.move_to_end(key)
+            return value
 
     def get(self, key: Any, default: Any = None) -> Any:
-        if key in self._data:
-            return self[key]
-        return default
+        with self._lock:
+            if key not in self._data:
+                return default
+            value = self._data[key]
+            self._data.move_to_end(key)
+            return value
 
     def __setitem__(self, key: Any, value: Any) -> None:
-        if key in self._data:
-            self._data.move_to_end(key)
-        self._data[key] = value
-        while len(self._data) > self.maxsize:
-            self._data.popitem(last=False)
+        with self._lock:
+            if key in self._data:
+                self._data.move_to_end(key)
+            self._data[key] = value
+            while len(self._data) > self.maxsize:
+                self._data.popitem(last=False)
 
     def __len__(self) -> int:
-        return len(self._data)
+        with self._lock:
+            return len(self._data)
 
     def clear(self) -> None:
-        self._data.clear()
+        with self._lock:
+            self._data.clear()
