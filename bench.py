@@ -4,9 +4,10 @@
 Workload: BASELINE.json config #1 — BOHB on the 2-D Branin toy, eta=3,
 budget ladder 1..81 — run two ways on the same machine:
 
-* **batched TPU path** (this framework's north star): every stage is one
-  jitted, vmapped dispatch on the accelerator; KDE proposals are one vmapped
-  kernel per stage.
+* **fused TPU path** (this framework's north star): the ENTIRE multi-bracket
+  sweep — KDE proposals, evaluations, top-k promotions, model refits — is
+  one compiled device program (``ops/sweep.py``); a run is one dispatch
+  plus one result fetch.
 * **reference-architecture path**: the same optimizer driven through the
   nameserver/dispatcher/worker pool, strictly one config per worker per TCP
   RPC round-trip — the reference's throughput ceiling
@@ -24,10 +25,13 @@ logging.disable(logging.WARNING)
 
 
 def bench_batched(n_iterations: int, seed: int = 0):
+    """Fused whole-sweep path: the entire multi-bracket BOHB run (proposals,
+    KDE fits, evaluations, promotions) is ONE compiled device program
+    (``ops/sweep.py``) — one dispatch + one result fetch per run."""
     import jax
 
-    from hpbandster_tpu.optimizers import BOHB
-    from hpbandster_tpu.parallel import BatchedExecutor, VmapBackend, config_mesh
+    from hpbandster_tpu.optimizers import FusedBOHB
+    from hpbandster_tpu.parallel import config_mesh
     from hpbandster_tpu.workloads.toys import branin_from_vector, branin_space
 
     devices = jax.devices()
@@ -35,19 +39,15 @@ def bench_batched(n_iterations: int, seed: int = 0):
 
     def run(n_iter, seed):
         cs = branin_space(seed=seed)
-        # min_pad=128 folds every stage size of this ladder into one
-        # compiled eval shape
-        backend = VmapBackend(branin_from_vector, mesh=mesh, min_pad=128)
-        executor = BatchedExecutor(backend, cs)
-        opt = BOHB(
-            configspace=cs, run_id=f"bench-{seed}", executor=executor,
-            min_budget=1, max_budget=81, eta=3, seed=seed,
+        opt = FusedBOHB(
+            configspace=cs, eval_fn=branin_from_vector, run_id=f"bench-{seed}",
+            min_budget=1, max_budget=81, eta=3, seed=seed, mesh=mesh,
         )
         t0 = time.perf_counter()
         opt.run(n_iterations=n_iter)
         dt = time.perf_counter() - t0
         opt.shutdown()
-        return executor.total_evaluated, dt
+        return opt.total_evaluated, dt
 
     run(n_iterations, seed=99)  # warmup: populate jit caches (compile time excluded)
     n_evals, dt = run(n_iterations, seed)

@@ -35,6 +35,7 @@ _EXPORTS = {
     "BOHB": "hpbandster_tpu.optimizers",
     "HyperBand": "hpbandster_tpu.optimizers",
     "RandomSearch": "hpbandster_tpu.optimizers",
+    "FusedBOHB": "hpbandster_tpu.optimizers",
 }
 
 

@@ -1,0 +1,369 @@
+"""Whole-sweep fusion: an entire multi-bracket BOHB run as ONE device program.
+
+The key observation: for the batched executor (static worker set, one
+bracket at a time) the complete BOHB sweep has a **static dataflow**. Bracket
+shapes come from the HyperBand arithmetic, observation counts per budget
+accumulate deterministically stage by stage, and therefore the good/bad KDE
+split sizes, the "largest budget with a trained model" choice, and every
+``top_k`` promotion width are Python constants at trace time. Only the data
+values are dynamic. So the *whole sweep* — proposal sampling, KDE fits,
+stage evaluations, promotion decisions — jits into a single XLA computation
+taking one uint32 seed and returning every bracket's configs and losses.
+
+Why it matters: the per-bracket path pays ~3 host<->device round-trips per
+bracket (proposal fetch + packed-result fetch), which dominates wall-clock
+on high-latency links (a tunneled TPU: ~75 ms each). The fused sweep pays
+ONE dispatch + one result fetch for the entire run.
+
+Reference semantics reproduced on-device (SURVEY.md §2 "BOHB config
+generator", §3.4): per-budget good/bad KDE split at ``top_n_percent``,
+``min_points_in_model`` gate, largest-trained-budget model selection,
+``random_fraction`` interleave, truncnorm-around-good-points candidates
+scored by ``l(x)/g(x)``, crashed runs recorded as maximally bad. Conditional
+spaces are NOT supported here (condition evaluation is host logic); the
+per-bracket path remains the fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hpbandster_tpu.ops.bracket import BracketPlan
+from hpbandster_tpu.ops.fused import fused_sh_bracket, _pack_stages
+from hpbandster_tpu.ops.kde import KDE, normal_reference_bandwidths, propose
+
+__all__ = ["SpaceCodec", "build_space_codec", "quantize_unit", "random_unit",
+           "make_fused_sweep_fn", "SweepBracketOutput"]
+
+
+class SpaceCodec(NamedTuple):
+    """Static per-dim description of a condition-free search space, enough to
+    quantize and sample unit-hypercube vectors entirely on-device.
+
+    Built host-side from a ``ConfigurationSpace`` (:func:`build_space_codec`)
+    and closed over at trace time — all arrays are plain numpy.
+
+    dim kinds: 0 = float, 1 = integer, 2 = categorical/ordinal (index repr),
+    3 = constant.
+    """
+
+    kind: np.ndarray      # int32[d]
+    log: np.ndarray       # bool[d]
+    lower: np.ndarray     # float64[d] (1.0-safe for non-log dims)
+    upper: np.ndarray     # float64[d]
+    q: np.ndarray         # float64[d]; NaN = no quantization
+    cards: np.ndarray     # int32[d] choices per discrete dim (0 = continuous)
+    vartypes: np.ndarray  # int32[d] KDE vartype codes ('c'=0,'u'=1,'o'=2)
+    logits: np.ndarray    # float32[d, kmax] sampling log-probs, -inf padded
+
+    @property
+    def signature(self) -> Tuple:
+        """Hashable identity for compile caches."""
+        return tuple(
+            (a.tobytes(), a.shape) for a in self
+        )
+
+
+def build_space_codec(configspace) -> SpaceCodec:
+    """Extract the static codec; raises ``ValueError`` for spaces the fused
+    sweep cannot represent (conditions, forbiddens)."""
+    from hpbandster_tpu.space.hyperparameters import (
+        CategoricalHyperparameter,
+        Constant,
+        OrdinalHyperparameter,
+        UniformFloatHyperparameter,
+        UniformIntegerHyperparameter,
+    )
+
+    if configspace.get_conditions() or configspace.get_forbiddens():
+        raise ValueError(
+            "fused sweep supports condition-free, forbidden-free spaces; "
+            "use the per-bracket batched path for conditional spaces"
+        )
+    hps = configspace.get_hyperparameters()
+    d = len(hps)
+    kind = np.zeros(d, np.int32)
+    log = np.zeros(d, bool)
+    lower = np.ones(d, np.float64)
+    upper = np.full(d, 2.0, np.float64)
+    q = np.full(d, np.nan, np.float64)
+    cards = np.zeros(d, np.int32)
+    kmax = max([hp.num_choices for hp in hps] + [1])
+    logits = np.full((d, kmax), -np.inf, np.float32)
+
+    for i, hp in enumerate(hps):
+        if isinstance(hp, Constant):
+            kind[i] = 3
+            cards[i] = 1
+            logits[i, 0] = 0.0
+        elif isinstance(hp, UniformFloatHyperparameter):
+            kind[i] = 0
+            log[i] = hp.log
+            lower[i], upper[i] = hp.lower, hp.upper
+            if hp.q is not None:
+                q[i] = hp.q
+        elif isinstance(hp, UniformIntegerHyperparameter):
+            kind[i] = 1
+            log[i] = hp.log
+            lower[i], upper[i] = hp.lower, hp.upper
+        elif isinstance(hp, CategoricalHyperparameter):
+            kind[i] = 2
+            cards[i] = hp.num_choices
+            logits[i, : hp.num_choices] = np.log(
+                np.maximum(np.asarray(hp.probabilities, np.float64), 1e-300)
+            )
+        elif isinstance(hp, OrdinalHyperparameter):
+            kind[i] = 2
+            cards[i] = hp.num_choices
+            logits[i, : hp.num_choices] = 0.0
+        else:
+            raise ValueError(f"unsupported hyperparameter type {type(hp).__name__}")
+    return SpaceCodec(
+        kind=kind, log=log, lower=lower, upper=upper, q=q, cards=cards,
+        vartypes=np.asarray(configspace.vartypes()), logits=logits,
+    )
+
+
+def _int_log_bounds(codec: SpaceCodec) -> Tuple[np.ndarray, np.ndarray]:
+    """The reference codec's widened log bounds for integer dims
+    (hyperparameters.py UniformIntegerHyperparameter)."""
+    lo = np.where(
+        codec.lower > 1, codec.lower - 0.4999, np.maximum(codec.lower, 1) * 0.5001
+    )
+    hi = codec.upper + 0.4999
+    return lo, hi
+
+
+def quantize_unit(codec: SpaceCodec, u: jax.Array) -> jax.Array:
+    """Jittable twin of host ``to_vector(from_vector(u))`` for condition-free
+    spaces: snap unit-hypercube vectors to representable configurations.
+
+    ``u`` is ``f32[..., d]``. Bit-level parity with the host codec is not
+    required (both are fixed points of each other's rounding; the bin-center
+    integer convention makes the decode robust to f32 rounding).
+    """
+    kind = jnp.asarray(codec.kind)
+    u_raw = u.astype(jnp.float32)
+    # float/int dims live in [0,1]; categorical dims hold raw choice indices
+    u = jnp.clip(u_raw, 0.0, 1.0)
+
+    # floats: identity unless quantized (q), then value-space snap
+    lo = jnp.asarray(codec.lower, jnp.float32)
+    hi = jnp.asarray(codec.upper, jnp.float32)
+    safe_lo = jnp.maximum(lo, 1e-30)
+    log_lo, log_hi = jnp.log(safe_lo), jnp.log(jnp.maximum(hi, 1e-30))
+    val_lin = lo + u * (hi - lo)
+    val_log = jnp.exp(log_lo + u * (log_hi - log_lo))
+    val = jnp.where(jnp.asarray(codec.log), val_log, val_lin)
+    qs = jnp.asarray(np.nan_to_num(codec.q, nan=1.0), jnp.float32)
+    has_q = jnp.asarray(np.isfinite(codec.q))
+    val_q = jnp.clip(jnp.round(val / qs) * qs, lo, hi)
+    enc_lin = (val_q - lo) / jnp.maximum(hi - lo, 1e-30)
+    enc_log = (jnp.log(jnp.maximum(val_q, 1e-30)) - log_lo) / jnp.maximum(
+        log_hi - log_lo, 1e-30
+    )
+    u_float = jnp.where(
+        has_q,
+        jnp.clip(jnp.where(jnp.asarray(codec.log), enc_log, enc_lin), 0.0, 1.0),
+        u,
+    )
+
+    # integers: decode (bin-center / widened-log), round, re-encode
+    ilo, ihi = _int_log_bounds(codec)
+    ilo = jnp.asarray(ilo, jnp.float32)
+    ihi = jnp.asarray(ihi, jnp.float32)
+    n_int = jnp.maximum(hi - lo + 1.0, 1.0)
+    v_lin = lo - 0.5 + u * n_int
+    log_ilo = jnp.log(jnp.maximum(ilo, 1e-30))
+    log_ihi = jnp.log(jnp.maximum(ihi, 1e-30))
+    v_log = jnp.exp(log_ilo + u * (log_ihi - log_ilo))
+    vi = jnp.clip(jnp.round(jnp.where(jnp.asarray(codec.log), v_log, v_lin)), lo, hi)
+    enc_i_lin = (vi - lo + 0.5) / n_int
+    enc_i_log = jnp.clip(
+        (jnp.log(jnp.maximum(vi, 1e-30)) - log_ilo)
+        / jnp.maximum(log_ihi - log_ilo, 1e-30),
+        0.0,
+        1.0,
+    )
+    u_int = jnp.where(jnp.asarray(codec.log), enc_i_log, enc_i_lin)
+
+    # categorical / ordinal: snap to the nearest index
+    kf = jnp.maximum(jnp.asarray(codec.cards, jnp.float32), 1.0)
+    u_cat = jnp.clip(jnp.round(u_raw), 0.0, kf - 1.0)
+
+    out = jnp.where(kind == 0, u_float, u)
+    out = jnp.where(kind == 1, u_int, out)
+    out = jnp.where(kind == 2, u_cat, out)
+    out = jnp.where(kind == 3, 0.0, out)
+    return out
+
+
+def random_unit(codec: SpaceCodec, key: jax.Array, n: int) -> jax.Array:
+    """``n`` uniform configuration vectors, matching the host sampler's
+    semantics per dim (uniform unit for float/int, weighted categorical,
+    uniform ordinal, 0 for constants). Returns un-quantized ``f32[n, d]`` —
+    pass through :func:`quantize_unit` before evaluating."""
+    d = codec.kind.shape[0]
+    k_u, k_c = jax.random.split(key)
+    u = jax.random.uniform(k_u, (n, d))
+    idx = jax.random.categorical(
+        k_c, jnp.asarray(codec.logits)[None, :, :], axis=-1, shape=(n, d)
+    ).astype(jnp.float32)
+    kind = jnp.asarray(codec.kind)
+    out = jnp.where(kind == 2, idx, u)
+    out = jnp.where(kind == 3, 0.0, out)
+    return out
+
+
+class SweepBracketOutput(NamedTuple):
+    """Per-bracket device outputs of the fused sweep."""
+
+    #: quantized stage-0 configuration vectors, f32[n0, d]
+    vectors: jax.Array
+    #: True where the proposal was model-based, bool[n0]
+    model_based: jax.Array
+    #: stage-major concatenation of original-row indices, i32[sum(ns)]
+    idx_packed: jax.Array
+    #: matching losses (NaN = crashed), f32[sum(ns)]
+    loss_packed: jax.Array
+
+
+def _fit_kde_pair_device(
+    vecs: jax.Array,
+    losses: jax.Array,
+    n_good: int,
+    n_bad: int,
+    cards: jax.Array,
+    min_bandwidth: float,
+) -> Tuple[KDE, KDE]:
+    """Device twin of BOHBKDE._fit_kde_pair/_make_kde for imputation-free
+    (condition-free) observations: stable sort by loss, top ``n_good`` /
+    bottom ``n_bad`` rows, normal-reference bandwidths."""
+    n = vecs.shape[0]
+    order = jnp.argsort(losses, stable=True)
+    good = vecs[order[:n_good]]
+    bad = vecs[order[n - n_bad:]]
+
+    def mk(data: jax.Array) -> KDE:
+        mask = jnp.ones(data.shape[0], jnp.float32)
+        bw = normal_reference_bandwidths(data, mask, cards, min_bandwidth)
+        return KDE(data, mask, bw)
+
+    return mk(good), mk(bad)
+
+
+def make_fused_sweep_fn(
+    eval_fn: Callable[[jax.Array, float], jax.Array],
+    plans: Sequence[BracketPlan],
+    codec: SpaceCodec,
+    *,
+    num_samples: int = 64,
+    random_fraction: float = 1 / 3,
+    top_n_percent: int = 15,
+    min_points_in_model: Optional[int] = None,
+    bandwidth_factor: float = 3.0,
+    min_bandwidth: float = 1e-3,
+    mesh=None,
+    axis: str = "config",
+) -> Callable[[np.uint32], List[SweepBracketOutput]]:
+    """Trace + jit the whole sweep; returns ``fn(seed) -> [SweepBracketOutput]``.
+
+    Model bookkeeping mirrors ``models/bohb_kde.py`` with all counts static:
+    a budget's KDE pair exists once it holds ``min_points_in_model + 2``
+    observations and both split sides exceed ``dim``; proposals use the
+    largest such budget, refit at every bracket start from all observations
+    accumulated so far (the batched path's stage-chunked model updates).
+    """
+    d = int(codec.kind.shape[0])
+    min_pts = (d + 1) if min_points_in_model is None else max(int(min_points_in_model), d + 1)
+    plans = [BracketPlan(tuple(p.num_configs), tuple(p.budgets)) for p in plans]
+
+    # static per-budget observation capacities across the whole sweep
+    caps: dict = {}
+    for plan in plans:
+        for k, b in zip(plan.num_configs, plan.budgets):
+            caps[float(b)] = caps.get(float(b), 0) + int(k)
+
+    vartypes_dev = jnp.asarray(codec.vartypes)
+    cards_dev = jnp.asarray(codec.cards)
+
+    def trained_split(n: int) -> Optional[Tuple[int, int]]:
+        """Host-side static twin of the _fit_kde_pair gate."""
+        if n < min_pts + 2:
+            return None
+        n_good = max(min_pts, (top_n_percent * n) // 100)
+        n_bad = max(min_pts, ((100 - top_n_percent) * n) // 100)
+        if n_good <= d or n_bad <= d:
+            return None
+        return n_good, n_bad
+
+    def sweep(seed: jax.Array) -> List[SweepBracketOutput]:
+        key = jax.random.key(seed)
+        obs_v = {b: jnp.zeros((cap, d), jnp.float32) for b, cap in caps.items()}
+        obs_l = {b: jnp.zeros(cap, jnp.float32) for b, cap in caps.items()}
+        counts = {b: 0 for b in caps}  # python ints: static
+        outputs: List[SweepBracketOutput] = []
+
+        for b_i, plan in enumerate(plans):
+            n0 = plan.num_configs[0]
+            k_rand, k_prop, k_frac = jax.random.split(jax.random.fold_in(key, b_i), 3)
+            rand_vecs = random_unit(codec, k_rand, n0)
+
+            model_budget = None
+            for b in sorted(caps, reverse=True):
+                if trained_split(counts[b]) is not None:
+                    model_budget = b
+                    break
+
+            if model_budget is None:
+                proposals = rand_vecs
+                mb_mask = jnp.zeros(n0, bool)
+            else:
+                n = counts[model_budget]
+                n_good, n_bad = trained_split(n)
+                good, bad = _fit_kde_pair_device(
+                    obs_v[model_budget][:n], obs_l[model_budget][:n],
+                    n_good, n_bad, cards_dev, min_bandwidth,
+                )
+                keys = jax.random.split(k_prop, n0)
+                model_vecs = jax.vmap(
+                    lambda k: propose(
+                        k, good, bad, vartypes_dev, cards_dev,
+                        num_samples, bandwidth_factor, min_bandwidth,
+                    )[0]
+                )(keys)
+                mb_mask = jax.random.uniform(k_frac, (n0,)) >= random_fraction
+                proposals = jnp.where(mb_mask[:, None], model_vecs, rand_vecs)
+
+            vectors = quantize_unit(codec, proposals)
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                vectors = jax.lax.with_sharding_constraint(
+                    vectors, NamedSharding(mesh, PartitionSpec(axis))
+                )
+
+            stages = fused_sh_bracket(eval_fn, vectors, plan.num_configs, plan.budgets)
+
+            for (idx_s, losses_s), k_s, budget in zip(
+                stages, plan.num_configs, plan.budgets
+            ):
+                b = float(budget)
+                c = counts[b]
+                obs_v[b] = obs_v[b].at[c:c + k_s].set(vectors[idx_s])
+                obs_l[b] = obs_l[b].at[c:c + k_s].set(
+                    jnp.where(jnp.isnan(losses_s), jnp.inf, losses_s)
+                )
+                counts[b] = c + k_s
+
+            idx_packed, loss_packed = _pack_stages(stages)
+            outputs.append(
+                SweepBracketOutput(vectors[:n0], mb_mask, idx_packed, loss_packed)
+            )
+        return outputs
+
+    return jax.jit(sweep)

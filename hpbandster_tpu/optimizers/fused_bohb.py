@@ -1,0 +1,256 @@
+"""FusedBOHB — the whole-sweep-on-device optimizer driver.
+
+Same knob surface as :class:`~hpbandster_tpu.optimizers.bohb.BOHB`, but
+instead of driving brackets through the Master/executor loop it compiles the
+ENTIRE ``n_iterations`` sweep into one XLA computation (``ops/sweep.py``)
+and replays the device outputs into the standard ``SuccessiveHalving`` /
+``Datum`` / ``Result`` bookkeeping afterward — so result logging, analysis
+and visualization tooling see exactly the structures the reference produces
+(SURVEY.md §2 "Result / logging"), while the optimization itself pays one
+device dispatch + one result fetch for the whole run.
+
+Use this when the objective is jittable and the space is condition-free;
+otherwise use ``BOHB`` with a ``BatchedExecutor`` (per-bracket fusion) or
+the host worker pool.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from hpbandster_tpu.core.job import Job
+from hpbandster_tpu.core.result import Result
+from hpbandster_tpu.core.successive_halving import SuccessiveHalving
+from hpbandster_tpu.ops.bracket import (
+    budget_ladder,
+    hyperband_bracket,
+    max_sh_iterations,
+)
+from hpbandster_tpu.ops.sweep import build_space_codec, make_fused_sweep_fn
+from hpbandster_tpu.space import ConfigurationSpace
+from hpbandster_tpu.utils.lru import LRUCache
+
+__all__ = ["FusedBOHB"]
+
+#: process-wide compiled-sweep cache (same policy as the fused-bracket and
+#: batch caches: one compile per (objective, schedule, space, knobs, mesh))
+_SWEEP_FN_CACHE: LRUCache = LRUCache(maxsize=16)
+
+
+class _ReplayIteration(SuccessiveHalving):
+    """SuccessiveHalving whose promotion decisions replay the device's.
+
+    The fused sweep already decided every promotion on-device; the host
+    bookkeeping must record those decisions verbatim (they follow the same
+    top-k rule, but the device is authoritative)."""
+
+    def __init__(self, *args, promotion_sets: List[set], **kwargs):
+        super().__init__(*args, **kwargs)
+        self._promotion_sets = promotion_sets
+
+    def _advance_to_next_stage(self, config_ids, losses) -> np.ndarray:
+        promoted = self._promotion_sets[self.stage]
+        return np.array([cid[2] in promoted for cid in config_ids], bool)
+
+
+class FusedBOHB:
+    def __init__(
+        self,
+        configspace: Optional[ConfigurationSpace] = None,
+        eval_fn=None,
+        run_id: str = "fused",
+        eta: float = 3,
+        min_budget: float = 0.01,
+        max_budget: float = 1,
+        min_points_in_model: Optional[int] = None,
+        top_n_percent: int = 15,
+        num_samples: int = 64,
+        random_fraction: float = 1 / 3,
+        bandwidth_factor: float = 3.0,
+        min_bandwidth: float = 1e-3,
+        seed: Optional[int] = None,
+        mesh=None,
+        axis: str = "config",
+        result_logger=None,
+        working_directory: str = ".",
+        logger: Optional[logging.Logger] = None,
+    ):
+        if configspace is None:
+            raise ValueError("you have to provide a valid ConfigurationSpace object")
+        if eval_fn is None:
+            raise ValueError(
+                "FusedBOHB needs a jittable eval_fn(config_vector, budget) -> loss"
+            )
+        self.configspace = configspace
+        self.codec = build_space_codec(configspace)  # raises on conditional spaces
+        self.eval_fn = eval_fn
+        self.run_id = run_id
+        self.eta = float(eta)
+        self.min_budget = float(min_budget)
+        self.max_budget = float(max_budget)
+        self.min_points_in_model = min_points_in_model
+        self.top_n_percent = int(top_n_percent)
+        self.num_samples = int(num_samples)
+        self.random_fraction = float(random_fraction)
+        self.bandwidth_factor = float(bandwidth_factor)
+        self.min_bandwidth = float(min_bandwidth)
+        self.mesh = mesh
+        self.axis = axis
+        self.result_logger = result_logger
+        self.working_directory = working_directory
+        self.logger = logger or logging.getLogger("hpbandster_tpu.fused_bohb")
+        self.rng = np.random.default_rng(seed)
+
+        self.max_SH_iter = max_sh_iterations(min_budget, max_budget, eta)
+        self.budgets = budget_ladder(min_budget, max_budget, eta)
+        self.iterations: List[SuccessiveHalving] = []
+        self.config: Dict[str, Any] = {
+            "time_ref": None,
+            "eta": self.eta,
+            "min_budget": self.min_budget,
+            "max_budget": self.max_budget,
+            "budgets": list(self.budgets),
+            "max_SH_iter": self.max_SH_iter,
+            "min_points_in_model": min_points_in_model,
+            "top_n_percent": top_n_percent,
+            "num_samples": num_samples,
+            "random_fraction": random_fraction,
+            "bandwidth_factor": bandwidth_factor,
+            "min_bandwidth": min_bandwidth,
+        }
+        #: stats for tests/benchmarks
+        self.total_evaluated = 0
+
+    # ------------------------------------------------------------------ run
+    def _sweep_fn(self, plans):
+        key = (
+            self.eval_fn,
+            tuple((p.num_configs, p.budgets) for p in plans),
+            self.codec.signature,
+            self.num_samples,
+            self.random_fraction,
+            self.top_n_percent,
+            self.min_points_in_model,
+            self.bandwidth_factor,
+            self.min_bandwidth,
+            self.mesh,
+            self.axis,
+        )
+        fn = _SWEEP_FN_CACHE.get(key)
+        if fn is None:
+            fn = make_fused_sweep_fn(
+                self.eval_fn,
+                plans,
+                self.codec,
+                num_samples=self.num_samples,
+                random_fraction=self.random_fraction,
+                top_n_percent=self.top_n_percent,
+                min_points_in_model=self.min_points_in_model,
+                bandwidth_factor=self.bandwidth_factor,
+                min_bandwidth=self.min_bandwidth,
+                mesh=self.mesh,
+                axis=self.axis,
+            )
+            _SWEEP_FN_CACHE[key] = fn
+        return fn
+
+    def run(self, n_iterations: int = 1, min_n_workers: int = 1) -> Result:
+        """Run brackets as one fused device computation.
+
+        ``n_iterations`` is the TOTAL bracket count including previous
+        ``run()`` calls on this instance (Master.run's resume semantics):
+        a second call only runs the remaining brackets, continuing the
+        HyperBand bracket rotation. Each call is its own fused computation —
+        device-side model state does not carry across calls.
+        """
+        del min_n_workers  # API symmetry with Master.run; no worker pool here
+        import jax
+
+        first = len(self.iterations)
+        plans = [
+            hyperband_bracket(i, self.min_budget, self.max_budget, self.eta)
+            for i in range(first, int(n_iterations))
+        ]
+        if self.config["time_ref"] is None:
+            self.config["time_ref"] = time.time()
+
+        if plans:
+            seed = np.uint32(self.rng.integers(2**32, dtype=np.uint32))
+            outputs = jax.device_get(self._sweep_fn(tuple(plans))(seed))
+            for b_i, (plan, out) in enumerate(zip(plans, outputs), start=first):
+                self._replay_bracket(b_i, plan, out)
+        return Result(list(self.iterations), self.config)
+
+    # --------------------------------------------------------------- replay
+    def _replay_bracket(self, b_i: int, plan, out) -> None:
+        from hpbandster_tpu.ops.fused import _unpack_stages
+
+        vectors = np.asarray(out.vectors)
+        mb_mask = np.asarray(out.model_based)
+        stages = _unpack_stages(
+            (out.idx_packed, out.loss_packed), plan.num_configs
+        )
+        promotion_sets = [set(int(i) for i in idx) for idx, _ in stages[1:]]
+        promotion_sets.append(set())
+
+        def no_sampler(budget):  # replay adds every config explicitly
+            raise RuntimeError("fused replay must not sample fresh configs")
+
+        it = _ReplayIteration(
+            HPB_iter=b_i,
+            num_configs=list(plan.num_configs),
+            budgets=list(plan.budgets),
+            config_sampler=no_sampler,
+            promotion_sets=promotion_sets,
+            result_logger=self.result_logger,
+        )
+        self.iterations.append(it)
+
+        for i in range(plan.num_configs[0]):
+            cfg = dict(self.configspace.from_vector(vectors[i]))
+            it.add_configuration(
+                cfg,
+                {
+                    "model_based_pick": bool(mb_mask[i]),
+                    "fused_sweep": True,
+                },
+            )
+
+        loss_of = [dict(zip(map(int, idx), map(float, losses))) for idx, losses in stages]
+        stage_no = 0
+        while True:
+            nr = it.get_next_run()
+            if nr is None:
+                if not it.process_results():
+                    break
+                stage_no += 1
+                continue
+            config_id, cfg, budget = nr
+            job = Job(
+                config_id,
+                config=cfg,
+                budget=budget,
+                working_directory=self.working_directory,
+            )
+            job.time_it("submitted")
+            job.time_it("started")
+            loss = loss_of[stage_no][config_id[2]]
+            # mirror register_result: only NaN means crashed; a genuine
+            # +/-inf loss (diverged run) is a valid maximally-bad result
+            if not np.isnan(loss):
+                job.result = {"loss": loss, "info": {}}
+            else:
+                job.result = None
+                job.exception = f"non-finite loss {loss!r} at budget {budget}"
+            job.time_it("finished")
+            if self.result_logger is not None:
+                self.result_logger(job)
+            it.register_result(job)
+            self.total_evaluated += 1
+
+    def shutdown(self, shutdown_workers: bool = False) -> None:
+        """API symmetry with Master; nothing to tear down."""
