@@ -39,7 +39,10 @@ def max_sh_iterations(min_budget: float, max_budget: float, eta: float) -> int:
         raise ValueError(f"need 0 < min_budget <= max_budget, got [{min_budget}, {max_budget}]")
     if eta <= 1:
         raise ValueError(f"need eta > 1, got {eta}")
-    return int(np.floor(np.log(max_budget / min_budget) / np.log(eta))) + 1
+    # epsilon-robust floor: log(243)/log(3) = 4.999999999999999 in f64, and a
+    # bare floor would silently drop the lowest rung of an exact ladder
+    ratio = np.log(max_budget / min_budget) / np.log(eta)
+    return int(np.floor(ratio + 1e-9)) + 1
 
 
 def budget_ladder(min_budget: float, max_budget: float, eta: float) -> np.ndarray:

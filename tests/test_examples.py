@@ -78,6 +78,17 @@ def test_example_7_tpu_batched():
 
 
 def test_example_8_large_sweep():
-    out = run_example("example_8_large_sweep.py", "--n_iterations", "6")
+    out = run_example(
+        "example_8_large_sweep.py", "--n_iterations", "4", "--max_budget", "9"
+    )
     assert "incumbent loss" in out
-    assert "fused" in out
+    assert "fused whole-sweep" in out
+
+
+def test_example_8_large_sweep_per_bracket():
+    out = run_example(
+        "example_8_large_sweep.py", "--n_iterations", "4", "--max_budget", "9",
+        "--no-fused",
+    )
+    assert "incumbent loss" in out
+    assert "per-bracket batched" in out

@@ -20,6 +20,12 @@ class TestSchedule:
         assert max_sh_iterations(1, 1, 3) == 1
         # reference BOHB defaults: min=0.01, max=1, eta=3 -> 5 rungs
         assert max_sh_iterations(0.01, 1.0, 3) == 5
+        # fp-edge regression: log(243)/log(3) = 4.999...9 in f64; a bare
+        # floor dropped the lowest rung
+        assert max_sh_iterations(1, 243, 3) == 6
+        np.testing.assert_allclose(
+            budget_ladder(1, 243, 3), [1.0, 3.0, 9.0, 27.0, 81.0, 243.0]
+        )
 
     def test_budget_ladder(self):
         np.testing.assert_allclose(budget_ladder(1, 9, 3), [1.0, 3.0, 9.0])
