@@ -36,6 +36,7 @@ _EXPORTS = {
     "HyperBand": "hpbandster_tpu.optimizers",
     "RandomSearch": "hpbandster_tpu.optimizers",
     "FusedBOHB": "hpbandster_tpu.optimizers",
+    "FusedHyperBand": "hpbandster_tpu.optimizers",
 }
 
 

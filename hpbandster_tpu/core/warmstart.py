@@ -25,12 +25,21 @@ class WarmStartIteration:
     def __init__(self, result, config_generator):
         self.data: Dict[Any, Datum] = {}
         id2conf = result.get_id2config_mapping()
+        # re-key EVERY old iteration (live >= 0 AND previously-warmed < 0)
+        # onto fresh negative indices, descending by old index — chained warm
+        # starts then can never collide with the new run's live brackets
+        # (-1 - old would map an old -1 back to 0, shadowing live data)
+        remap = {
+            old: -1 - rank
+            for rank, old in enumerate(
+                sorted({cid[0] for cid in id2conf}, reverse=True)
+            )
+        }
         for old_id, conf in id2conf.items():
             runs = result.get_runs_by_id(old_id)
             if not runs:
                 continue
-            # re-key under iteration -1-<old iteration> to avoid collisions
-            new_id = (-1 - old_id[0], old_id[1], old_id[2])
+            new_id = (remap[old_id[0]], old_id[1], old_id[2])
             datum = Datum(
                 config=conf["config"],
                 config_info=conf["config_info"],

@@ -269,21 +269,29 @@ def make_fused_sweep_fn(
     min_bandwidth: float = 1e-3,
     mesh=None,
     axis: str = "config",
-) -> Callable[[np.uint32], List[SweepBracketOutput]]:
-    """Trace + jit the whole sweep; returns ``fn(seed) -> [SweepBracketOutput]``.
+    warm_counts: Optional[dict] = None,
+) -> Callable[..., List[SweepBracketOutput]]:
+    """Trace + jit the whole sweep; returns ``fn(seed[, warm_v, warm_l])``.
 
     Model bookkeeping mirrors ``models/bohb_kde.py`` with all counts static:
     a budget's KDE pair exists once it holds ``min_points_in_model + 2``
     observations and both split sides exceed ``dim``; proposals use the
     largest such budget, refit at every bracket start from all observations
     accumulated so far (the batched path's stage-chunked model updates).
+
+    ``warm_counts`` (budget -> n, static) enables warm starting: the jitted
+    fn then takes two extra pytree args ``warm_v`` (budget -> f32[n, d]) and
+    ``warm_l`` (budget -> f32[n]) whose leaves seed the observation buffers
+    — traced inputs, so re-warming with fresh data of the same shape reuses
+    the compiled program.
     """
     d = int(codec.kind.shape[0])
     min_pts = (d + 1) if min_points_in_model is None else max(int(min_points_in_model), d + 1)
     plans = [BracketPlan(tuple(p.num_configs), tuple(p.budgets)) for p in plans]
+    warm_counts = {float(b): int(n) for b, n in (warm_counts or {}).items() if n > 0}
 
     # static per-budget observation capacities across the whole sweep
-    caps: dict = {}
+    caps: dict = {float(b): int(n) for b, n in warm_counts.items()}
     for plan in plans:
         for k, b in zip(plan.num_configs, plan.budgets):
             caps[float(b)] = caps.get(float(b), 0) + int(k)
@@ -301,11 +309,21 @@ def make_fused_sweep_fn(
             return None
         return n_good, n_bad
 
-    def sweep(seed: jax.Array) -> List[SweepBracketOutput]:
+    def sweep(
+        seed: jax.Array, warm_v=None, warm_l=None
+    ) -> List[SweepBracketOutput]:
         key = jax.random.key(seed)
         obs_v = {b: jnp.zeros((cap, d), jnp.float32) for b, cap in caps.items()}
         obs_l = {b: jnp.zeros(cap, jnp.float32) for b, cap in caps.items()}
         counts = {b: 0 for b in caps}  # python ints: static
+        for b, n in warm_counts.items():
+            obs_v[b] = obs_v[b].at[:n].set(warm_v[b].astype(jnp.float32))
+            obs_l[b] = obs_l[b].at[:n].set(
+                jnp.where(jnp.isnan(warm_l[b]), jnp.inf, warm_l[b]).astype(
+                    jnp.float32
+                )
+            )
+            counts[b] = n
         outputs: List[SweepBracketOutput] = []
 
         for b_i, plan in enumerate(plans):

@@ -4,4 +4,7 @@ from hpbandster_tpu.optimizers.hyperband import HyperBand  # noqa: F401
 from hpbandster_tpu.optimizers.bohb import BOHB  # noqa: F401
 from hpbandster_tpu.optimizers.randomsearch import RandomSearch  # noqa: F401
 from hpbandster_tpu.optimizers.h2bo import H2BO  # noqa: F401
-from hpbandster_tpu.optimizers.fused_bohb import FusedBOHB  # noqa: F401
+from hpbandster_tpu.optimizers.fused_bohb import (  # noqa: F401
+    FusedBOHB,
+    FusedHyperBand,
+)

@@ -34,7 +34,7 @@ from hpbandster_tpu.ops.sweep import build_space_codec, make_fused_sweep_fn
 from hpbandster_tpu.space import ConfigurationSpace
 from hpbandster_tpu.utils.lru import LRUCache
 
-__all__ = ["FusedBOHB"]
+__all__ = ["FusedBOHB", "FusedHyperBand"]
 
 #: process-wide compiled-sweep cache (same policy as the fused-bracket and
 #: batch caches: one compile per (objective, schedule, space, knobs, mesh))
@@ -78,6 +78,7 @@ class FusedBOHB:
         result_logger=None,
         working_directory: str = ".",
         logger: Optional[logging.Logger] = None,
+        previous_result: Optional[Result] = None,
     ):
         if configspace is None:
             raise ValueError("you have to provide a valid ConfigurationSpace object")
@@ -125,8 +126,48 @@ class FusedBOHB:
         #: stats for tests/benchmarks
         self.total_evaluated = 0
 
+        # warm start (reference: previous_result= replays old data into the
+        # model, SURVEY.md §5): old (config, budget, loss) observations seed
+        # the device observation buffers; the old data rides into the final
+        # Result as a finished pseudo-iteration under negative ids
+        self._warm_v: Dict[float, np.ndarray] = {}
+        self._warm_l: Dict[float, np.ndarray] = {}
+        self.warmstart_iteration: List[Any] = []
+        if previous_result is not None:
+            self._ingest_previous_result(previous_result)
+
+    def _ingest_previous_result(self, previous_result: Result) -> None:
+        from hpbandster_tpu.core.warmstart import WarmStartIteration
+
+        per_budget_v: Dict[float, List[np.ndarray]] = {}
+        per_budget_l: Dict[float, List[float]] = {}
+        id2conf = previous_result.get_id2config_mapping()
+        for run in previous_result.get_all_runs(only_largest_budget=False):
+            cfg = id2conf[run.config_id]["config"]
+            vec = np.nan_to_num(
+                self.configspace.to_vector(cfg), nan=0.0
+            ).astype(np.float32)
+            b = float(run.budget)
+            # crashed (None) losses register as maximally bad, like
+            # BOHBKDE.new_result
+            loss = np.inf if run.loss is None else float(run.loss)
+            per_budget_v.setdefault(b, []).append(vec)
+            per_budget_l.setdefault(b, []).append(loss)
+        for b in per_budget_v:
+            self._warm_v[b] = np.stack(per_budget_v[b])
+            self._warm_l[b] = np.asarray(per_budget_l[b], np.float32)
+
+        class _NoOpGenerator:
+            def new_result(self, job, update_model=True):
+                pass
+
+        self.warmstart_iteration = [
+            WarmStartIteration(previous_result, _NoOpGenerator())
+        ]
+
     # ------------------------------------------------------------------ run
     def _sweep_fn(self, plans):
+        warm_counts = {b: len(l) for b, l in self._warm_l.items()}
         key = (
             self.eval_fn,
             tuple((p.num_configs, p.budgets) for p in plans),
@@ -139,6 +180,7 @@ class FusedBOHB:
             self.min_bandwidth,
             self.mesh,
             self.axis,
+            tuple(sorted(warm_counts.items())),
         )
         fn = _SWEEP_FN_CACHE.get(key)
         if fn is None:
@@ -154,6 +196,7 @@ class FusedBOHB:
                 min_bandwidth=self.min_bandwidth,
                 mesh=self.mesh,
                 axis=self.axis,
+                warm_counts=warm_counts,
             )
             _SWEEP_FN_CACHE[key] = fn
         return fn
@@ -180,10 +223,18 @@ class FusedBOHB:
 
         if plans:
             seed = np.uint32(self.rng.integers(2**32, dtype=np.uint32))
-            outputs = jax.device_get(self._sweep_fn(tuple(plans))(seed))
+            if self._warm_l:
+                outputs = self._sweep_fn(tuple(plans))(
+                    seed, self._warm_v, self._warm_l
+                )
+            else:
+                outputs = self._sweep_fn(tuple(plans))(seed)
+            outputs = jax.device_get(outputs)
             for b_i, (plan, out) in enumerate(zip(plans, outputs), start=first):
                 self._replay_bracket(b_i, plan, out)
-        return Result(list(self.iterations), self.config)
+        return Result(
+            list(self.iterations) + self.warmstart_iteration, self.config
+        )
 
     # --------------------------------------------------------------- replay
     def _replay_bracket(self, b_i: int, plan, out) -> None:
@@ -254,3 +305,14 @@ class FusedBOHB:
 
     def shutdown(self, shutdown_workers: bool = False) -> None:
         """API symmetry with Master; nothing to tear down."""
+
+
+class FusedHyperBand(FusedBOHB):
+    """HyperBand on the fused whole-sweep path: identical bracket schedule,
+    pure-random proposals (no KDE is even traced — ``min_points_in_model``
+    is set unreachably high, so the model gate never opens)."""
+
+    def __init__(self, *args, **kwargs):
+        kwargs["random_fraction"] = 1.0
+        kwargs["min_points_in_model"] = 2**30
+        super().__init__(*args, **kwargs)

@@ -335,6 +335,74 @@ class TestFusedSweep:
         assert inf_runs, "expected some diverged (+inf) runs"
         assert all(r.loss is not None for r in runs)
 
+    def test_warmstart_from_previous_result(self):
+        """previous_result= seeds the device observation buffers: bracket 0
+        of the warm run can already make model-based picks, and the old data
+        rides into the Result under negative iteration ids."""
+        cs = branin_space(seed=0)
+        cold = FusedBOHB(
+            configspace=cs, eval_fn=branin_from_vector, run_id="w0",
+            min_budget=1, max_budget=27, eta=3, seed=11,
+        )
+        prev = cold.run(n_iterations=3)
+        warm = FusedBOHB(
+            configspace=cs, eval_fn=branin_from_vector, run_id="w1",
+            min_budget=1, max_budget=27, eta=3, seed=12,
+            previous_result=prev,
+        )
+        res = warm.run(n_iterations=1)
+        id2conf = res.get_id2config_mapping()
+        # old data present under negative iteration ids
+        assert any(cid[0] < 0 for cid in id2conf)
+        # bracket 0 already has model-based picks (cold run: impossible)
+        mb0 = [
+            cid for cid, c in id2conf.items()
+            if cid[0] == 0 and c["config_info"].get("model_based_pick")
+        ]
+        assert mb0, "warm start did not enable model-based picks in bracket 0"
+
+    def test_chained_warmstart_no_id_collision(self):
+        """Warm-starting from an already-warm-started Result must never remap
+        old ids onto live bracket ids."""
+        cs = branin_space(seed=0)
+        r1 = FusedBOHB(
+            configspace=cs, eval_fn=branin_from_vector, run_id="c0",
+            min_budget=1, max_budget=9, eta=3, seed=20,
+        ).run(n_iterations=1)
+        r2 = FusedBOHB(
+            configspace=cs, eval_fn=branin_from_vector, run_id="c1",
+            min_budget=1, max_budget=9, eta=3, seed=21, previous_result=r1,
+        ).run(n_iterations=1)
+        opt3 = FusedBOHB(
+            configspace=cs, eval_fn=branin_from_vector, run_id="c2",
+            min_budget=1, max_budget=9, eta=3, seed=22, previous_result=r2,
+        )
+        r3 = opt3.run(n_iterations=1)
+        id2conf = r3.get_id2config_mapping()
+        live = [cid for cid in id2conf if cid[0] >= 0]
+        warm = [cid for cid in id2conf if cid[0] < 0]
+        # 3 generations: live bracket-0 plus two warm generations, no overlap
+        assert {cid[0] for cid in live} == {0}
+        assert len({cid[0] for cid in warm}) == 2
+        # live bracket data intact: 13 configs for the (9,3,1) bracket
+        assert len(live) == 9
+        assert len(r3.get_all_runs()) == 13 * 3
+
+    def test_fused_hyperband_all_random(self):
+        from hpbandster_tpu.optimizers import FusedHyperBand
+
+        cs = branin_space(seed=0)
+        opt = FusedHyperBand(
+            configspace=cs, eval_fn=branin_from_vector, run_id="hb",
+            min_budget=1, max_budget=27, eta=3, seed=13,
+        )
+        res = opt.run(n_iterations=4)
+        id2conf = res.get_id2config_mapping()
+        assert len(res.get_all_runs()) > 0
+        assert not any(
+            c["config_info"].get("model_based_pick") for c in id2conf.values()
+        )
+
     def test_deterministic_given_seed(self):
         cs = branin_space(seed=0)
 
