@@ -283,6 +283,30 @@ class TestFusedSweep:
         assert len(res.get_all_runs()) > 0
         assert all(np.isfinite(r.loss) for r in res.get_all_runs())
 
+    def test_fused_sweep_on_cnn_training_workload(self):
+        """Real training workload on the fused path: budget (= SGD steps)
+        arrives as a concrete Python float inside the trace; the CNN's
+        while_loop-based trainer consumes it unchanged."""
+        from hpbandster_tpu.workloads import CNNConfig, cnn_space, make_cnn_eval_fn
+
+        cfg = CNNConfig(
+            image_size=8, channels=3, width=8, n_classes=4,
+            n_train=64, n_val=32, batch_size=32,
+        )
+        cs = cnn_space(seed=0)
+        opt = FusedBOHB(
+            configspace=cs, eval_fn=make_cnn_eval_fn(cfg), run_id="cnn-f",
+            min_budget=1, max_budget=4, eta=2, seed=14,
+        )
+        res = opt.run(n_iterations=2)
+        runs = res.get_all_runs()
+        assert len(runs) > 0
+        # extreme sampled hyperparameters may legitimately diverge to NaN
+        # (-> crashed, loss None); the healthy majority must be finite
+        finite = [r for r in runs if r.loss is not None]
+        assert len(finite) >= len(runs) // 2
+        assert all(np.isfinite(r.loss) for r in finite)
+
     def test_result_logger_compatible(self, tmp_path):
         from hpbandster_tpu.core.result import (
             json_result_logger,
