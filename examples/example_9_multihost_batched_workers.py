@@ -1,0 +1,85 @@
+"""Example 9: the multi-host batched tier — one TPU slice per worker.
+
+Between the single-program fused sweep (example 8) and the one-config-per-
+RPC host pool (examples 2-4) sits the ``TPUBatchedWorker`` tier: every
+worker process owns a TPU slice (its local ``jax.devices()``), registers
+with the nameserver like any other worker, and evaluates a whole *vector*
+of configurations per RPC as one sharded XLA dispatch. The master side
+(``RPCBatchBackend`` inside a ``BatchedExecutor``) splits each stage's wave
+across the registered workers proportional to their device counts, retries
+failed shards on survivors, and keeps the usual elastic join/leave
+semantics — so a pod of independent hosts behaves like one large batch
+evaluator without any global SPMD membership.
+
+This script demonstrates the full topology in one process (workers as
+background threads); point ``--nameserver`` at a remote host to split it
+for real. Plain dict-workers may share the same nameserver — the batch
+pool ignores them, the classic dispatcher can still use them.
+"""
+
+import argparse
+import time
+
+from hpbandster_tpu import NameServer
+from hpbandster_tpu.optimizers import BOHB
+from hpbandster_tpu.parallel import BatchedExecutor, RPCBatchBackend, TPUBatchedWorker
+from hpbandster_tpu.workloads.toys import BRANIN_OPT, branin_from_vector, branin_space
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n_workers", type=int, default=2)
+    p.add_argument("--n_iterations", type=int, default=8)
+    p.add_argument("--min_budget", type=float, default=1)
+    p.add_argument("--max_budget", type=float, default=81)
+    args = p.parse_args()
+
+    cs = branin_space(seed=0)
+    ns = NameServer(run_id="ex9", host="127.0.0.1", port=0)
+    host, port = ns.start()
+
+    # in production: one of these per host, each owning its local TPU slice
+    # (mesh="auto" shards each wave over all local devices)
+    workers = []
+    for i in range(args.n_workers):
+        w = TPUBatchedWorker(
+            run_id="ex9",
+            eval_fn=branin_from_vector,
+            configspace=cs,
+            mesh="auto" if i == 0 else None,  # demo: mixed device counts
+            nameserver=host,
+            nameserver_port=port,
+            id=i,
+        )
+        w.run(background=True)
+        workers.append(w)
+
+    backend = RPCBatchBackend("ex9", host, port)
+    backend.wait_for_workers(args.n_workers, timeout=30)
+    print(f"pool: {args.n_workers} batched workers, {backend.parallelism} devices")
+
+    bohb = BOHB(
+        configspace=cs, run_id="ex9",
+        executor=BatchedExecutor(backend, cs),
+        min_budget=args.min_budget, max_budget=args.max_budget, eta=3, seed=0,
+    )
+    t0 = time.perf_counter()
+    res = bohb.run(n_iterations=args.n_iterations)
+    dt = time.perf_counter() - t0
+    bohb.shutdown()
+
+    runs = res.get_all_runs()
+    print(
+        f"{len(runs)} evaluations over RPC waves in {dt:.1f}s "
+        f"({len(runs) / dt:.1f} configs/s)"
+    )
+    traj = res.get_incumbent_trajectory()
+    print(f"incumbent loss: {traj['losses'][-1]:.4f} (optimum ~{BRANIN_OPT:.4f})")
+
+    for w in workers:
+        w.shutdown()
+    ns.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,7 @@ _EXPORTS = {
     "RandomSearch": "hpbandster_tpu.optimizers",
     "FusedBOHB": "hpbandster_tpu.optimizers",
     "FusedHyperBand": "hpbandster_tpu.optimizers",
+    "FusedRandomSearch": "hpbandster_tpu.optimizers",
 }
 
 

@@ -7,4 +7,5 @@ from hpbandster_tpu.optimizers.h2bo import H2BO  # noqa: F401
 from hpbandster_tpu.optimizers.fused_bohb import (  # noqa: F401
     FusedBOHB,
     FusedHyperBand,
+    FusedRandomSearch,
 )

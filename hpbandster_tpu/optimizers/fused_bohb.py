@@ -26,6 +26,7 @@ from hpbandster_tpu.core.job import Job
 from hpbandster_tpu.core.result import Result
 from hpbandster_tpu.core.successive_halving import SuccessiveHalving
 from hpbandster_tpu.ops.bracket import (
+    BracketPlan,
     budget_ladder,
     hyperband_bracket,
     max_sh_iterations,
@@ -34,7 +35,7 @@ from hpbandster_tpu.ops.sweep import build_space_codec, make_fused_sweep_fn
 from hpbandster_tpu.space import ConfigurationSpace
 from hpbandster_tpu.utils.lru import LRUCache
 
-__all__ = ["FusedBOHB", "FusedHyperBand"]
+__all__ = ["FusedBOHB", "FusedHyperBand", "FusedRandomSearch"]
 
 #: process-wide compiled-sweep cache (same policy as the fused-bracket and
 #: batch caches: one compile per (objective, schedule, space, knobs, mesh))
@@ -166,6 +167,13 @@ class FusedBOHB:
         ]
 
     # ------------------------------------------------------------------ run
+    def _plan(self, iteration: int):
+        """Bracket shape for global iteration ``iteration`` — the
+        get_next_iteration seam of the fused tier."""
+        return hyperband_bracket(
+            iteration, self.min_budget, self.max_budget, self.eta
+        )
+
     def _sweep_fn(self, plans):
         warm_counts = {b: len(l) for b, l in self._warm_l.items()}
         key = (
@@ -214,10 +222,7 @@ class FusedBOHB:
         import jax
 
         first = len(self.iterations)
-        plans = [
-            hyperband_bracket(i, self.min_budget, self.max_budget, self.eta)
-            for i in range(first, int(n_iterations))
-        ]
+        plans = [self._plan(i) for i in range(first, int(n_iterations))]
         if self.config["time_ref"] is None:
             self.config["time_ref"] = time.time()
 
@@ -316,3 +321,24 @@ class FusedHyperBand(FusedBOHB):
         kwargs["random_fraction"] = 1.0
         kwargs["min_points_in_model"] = 2**30
         super().__init__(*args, **kwargs)
+
+
+class FusedRandomSearch(FusedHyperBand):
+    """RandomSearch on the fused path: degenerate single-stage brackets
+    sized like the matching HyperBand bracket's first stage, all evaluated
+    at ``max_budget`` (the reference baseline, SURVEY.md §2 'RandomSearch
+    optimizer')."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # host RandomSearch parity: every run executes at max_budget, so the
+        # Result's HB_config must not advertise the unused ladder
+        self.config["budgets"] = [self.max_budget]
+
+    def _plan(self, iteration: int):
+        base = hyperband_bracket(
+            iteration, self.min_budget, self.max_budget, self.eta
+        )
+        return BracketPlan(
+            num_configs=(base.num_configs[0],), budgets=(self.max_budget,)
+        )

@@ -92,3 +92,12 @@ def test_example_8_large_sweep_per_bracket():
     )
     assert "incumbent loss" in out
     assert "per-bracket batched" in out
+
+
+def test_example_9_multihost_batched_workers():
+    out = run_example(
+        "example_9_multihost_batched_workers.py",
+        "--n_iterations", "3", "--max_budget", "9",
+    )
+    assert "batched workers" in out
+    assert "incumbent loss" in out

@@ -427,6 +427,22 @@ class TestFusedSweep:
             c["config_info"].get("model_based_pick") for c in id2conf.values()
         )
 
+    def test_fused_randomsearch_single_stage_at_max_budget(self):
+        from hpbandster_tpu.optimizers import FusedRandomSearch
+
+        cs = branin_space(seed=0)
+        opt = FusedRandomSearch(
+            configspace=cs, eval_fn=branin_from_vector, run_id="rs",
+            min_budget=1, max_budget=27, eta=3, seed=15,
+        )
+        res = opt.run(n_iterations=3)
+        runs = res.get_all_runs()
+        assert len(runs) > 0
+        assert all(r.budget == 27.0 for r in runs)
+        # sized like the matching HyperBand brackets' stage 0
+        plans = hyperband_schedule(3, 1, 27, 3)
+        assert len(runs) == sum(p.num_configs[0] for p in plans)
+
     def test_deterministic_given_seed(self):
         cs = branin_space(seed=0)
 
