@@ -83,10 +83,10 @@ def _pack_stages(stages):
 
 
 def _unpack_stages(packed, num_configs):
-    import numpy as np
-
-    idx_flat = np.asarray(packed[0])
-    loss_flat = np.asarray(packed[1])
+    # one device_get over the pair: both transfers issue together instead of
+    # the second blocking behind the first (round-trips dominate on
+    # high-latency links)
+    idx_flat, loss_flat = jax.device_get(tuple(packed))
     out, off = [], 0
     for k in num_configs:
         out.append((idx_flat[off:off + k], loss_flat[off:off + k]))
@@ -134,8 +134,10 @@ def make_fused_bracket_fn(
     if mesh is None:
         jitted_plain = jax.jit(bracket)
 
-        def runner(vectors):
-            return _unpack_stages(jitted_plain(vectors), num_configs)
+        def dispatch(vectors):
+            """Launch the bracket; returns packed DEVICE arrays without
+            blocking — callers may overlap several brackets before fetching."""
+            return jitted_plain(vectors)
 
     else:
         from jax.sharding import NamedSharding, PartitionSpec
@@ -145,7 +147,7 @@ def make_fused_bracket_fn(
         shard = NamedSharding(mesh, PartitionSpec(axis))
         jitted = jax.jit(bracket, in_shardings=(shard,))
 
-        def runner(vectors):
+        def dispatch(vectors):
             vectors = np.asarray(vectors, np.float32)
             if vectors.shape[0] != n0:
                 raise ValueError(
@@ -155,7 +157,11 @@ def make_fused_bracket_fn(
                 vectors = np.concatenate(
                     [vectors, np.zeros((n_pad - n0, vectors.shape[1]), np.float32)]
                 )
-            return _unpack_stages(jitted(vectors), num_configs)
+            return jitted(vectors)
 
+    def runner(vectors):
+        return _unpack_stages(dispatch(vectors), num_configs)
+
+    runner.dispatch = dispatch
     _FUSED_FN_CACHE[cache_key] = runner
     return runner

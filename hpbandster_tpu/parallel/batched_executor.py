@@ -37,11 +37,6 @@ __all__ = ["BatchedExecutor"]
 class BatchedExecutor:
     #: tells the Master not to throttle submissions on a worker-sized queue
     unbounded_queue = True
-    #: one bracket at a time: every fresh sample sees all earlier results
-    #: (most sample-efficient; a stage is still one big device batch).
-    #: Raise via Master.parallel_brackets to trade sample efficiency for
-    #: cross-bracket batching on large meshes.
-    preferred_parallel_brackets = 1
     #: stage quotas are filled through get_config_batch (one vmapped
     #: proposal kernel) instead of per-config get_config calls
     prefers_batched_sampling = True
@@ -51,11 +46,17 @@ class BatchedExecutor:
         backend,
         configspace: ConfigurationSpace,
         fuse_brackets: bool = True,
+        parallel_brackets: int = 1,
         logger: Optional[logging.Logger] = None,
     ):
         self.backend = backend
         self.configspace = configspace
         self.fuse_brackets = bool(fuse_brackets) and hasattr(backend, "eval_fn")
+        # >1 pipelines brackets: bracket k+1's stage-0 wave is sampled (from
+        # a one-bracket-stale model — the reference's own asynchrony) and
+        # dispatched before bracket k's results are fetched, overlapping
+        # device work with transfers on high-latency links
+        self.preferred_parallel_brackets = max(int(parallel_brackets), 1)
         self.logger = logger or logging.getLogger("hpbandster_tpu.batched_executor")
         self.buffer: List[Job] = []
         self._new_result_callback: Optional[Callable[[Job], None]] = None
@@ -81,6 +82,14 @@ class BatchedExecutor:
         return len(self.buffer)
 
     # ------------------------------------------------------------- delivery
+    def _crash_wave(self, jobs: List[Job], exc: Exception, where: str) -> None:
+        """A bracket-level failure crashes only its own wave's jobs (the
+        stage-batched path's containment, lifted to fused brackets)."""
+        self.logger.exception("%s failed; wave of %d crashes", where, len(jobs))
+        for j in jobs:
+            j.exception = f"{where} failed: {exc!r}"
+            self._finish(j, float("nan"))
+
     def _finish(self, job: Job, loss: float) -> None:
         job.time_it("finished")
         if np.isfinite(loss):
@@ -95,62 +104,88 @@ class BatchedExecutor:
 
     # ---------------------------------------------------------- fused path
     def _try_fuse(self, jobs: List[Job]) -> Optional[List[Job]]:
-        """If ``jobs`` is one bracket's complete stage-0 wave, run the whole
-        bracket fused; returns the remaining (non-fused) jobs or None if
-        fusion did not apply."""
-        info = getattr(jobs[0], "bracket_info", None)
-        if info is None or info["stage"] != 0 or len(info["num_configs"]) < 2:
-            return None
-        iteration = jobs[0].id[0]
-        same = all(
-            getattr(j, "bracket_info", None) == info and j.id[0] == iteration
-            for j in jobs
-        )
-        if not same or len(jobs) != info["num_configs"][0]:
-            return None
+        """Fuse every complete stage-0 bracket wave found in ``jobs``.
 
-        from hpbandster_tpu.ops.fused import make_fused_bracket_fn
+        Multiple brackets may be buffered at once (``parallel_brackets > 1``):
+        each complete wave becomes its own fused computation, ALL of them
+        dispatched before the first result fetch so their device work and
+        transfers overlap. Returns the leftover (non-fused) jobs, or None if
+        nothing was fused."""
+        from hpbandster_tpu.ops.fused import _unpack_stages, make_fused_bracket_fn
 
-        shape_key = (info["num_configs"], info["budgets"])
-        if shape_key not in self._fused_fns:
-            self._fused_fns[shape_key] = make_fused_bracket_fn(
-                self.backend.eval_fn,
-                info["num_configs"],
-                info["budgets"],
-                mesh=getattr(self.backend, "mesh", None),
-                axis=getattr(self.backend, "axis", "config"),
+        groups: Dict[int, List[Job]] = {}
+        leftovers: List[Job] = []
+        for j in jobs:
+            info = getattr(j, "bracket_info", None)
+            if info is None or info["stage"] != 0 or len(info["num_configs"]) < 2:
+                leftovers.append(j)
+            else:
+                groups.setdefault(j.id[0], []).append(j)
+
+        dispatched = []
+        crashed = False
+        for iteration, gjobs in sorted(groups.items()):
+            info = gjobs[0].bracket_info
+            complete = (
+                all(getattr(j, "bracket_info", None) == info for j in gjobs)
+                and len(gjobs) == info["num_configs"][0]
             )
-
-        jobs_sorted = sorted(jobs, key=lambda j: j.id)
-        vectors = np.stack(
-            [
-                np.nan_to_num(
-                    self.configspace.to_vector(j.kwargs["config"]), nan=0.0
+            if not complete:
+                leftovers.extend(gjobs)
+                continue
+            shape_key = (info["num_configs"], info["budgets"])
+            if shape_key not in self._fused_fns:
+                self._fused_fns[shape_key] = make_fused_bracket_fn(
+                    self.backend.eval_fn,
+                    info["num_configs"],
+                    info["budgets"],
+                    mesh=getattr(self.backend, "mesh", None),
+                    axis=getattr(self.backend, "axis", "config"),
                 )
-                for j in jobs_sorted
-            ]
-        ).astype(np.float32)
-        for j in jobs_sorted:
-            j.time_it("started")
-        stages = self._fused_fns[shape_key](vectors)
-        self.fused_brackets_run += 1
+            jobs_sorted = sorted(gjobs, key=lambda j: j.id)
+            vectors = np.stack(
+                [
+                    np.nan_to_num(
+                        self.configspace.to_vector(j.kwargs["config"]), nan=0.0
+                    )
+                    for j in jobs_sorted
+                ]
+            ).astype(np.float32)
+            for j in jobs_sorted:
+                j.time_it("started")
+            try:
+                packed = self._fused_fns[shape_key].dispatch(vectors)
+            except Exception as e:  # contain: only THIS bracket's wave crashes
+                self._crash_wave(jobs_sorted, e, "fused dispatch")
+                crashed = True
+                continue
+            dispatched.append((iteration, info, jobs_sorted, packed))
 
-        # stage 0 results feed back immediately; stages >= 1 fill the cache
-        stage0_losses = np.asarray(stages[0][1])
-        for s, (idx, losses) in enumerate(stages[1:], start=1):
-            idx = np.asarray(idx)
-            losses = np.asarray(losses)
-            budget = info["budgets"][s]
-            for i, loss in zip(idx, losses):
-                cid = jobs_sorted[int(i)].id
-                self._fused_cache[(cid, float(budget))] = float(loss)
-        self.logger.debug(
-            "fused bracket %d: %s evals in one dispatch",
-            iteration, sum(len(np.asarray(i)) for i, _ in stages),
-        )
-        for j, loss in zip(jobs_sorted, stage0_losses):
-            self._finish(j, loss)
-        return []
+        if not dispatched and not crashed:
+            # nothing fused, nothing consumed: let the caller stage-batch
+            return None
+
+        for iteration, info, jobs_sorted, packed in dispatched:
+            try:
+                stages = _unpack_stages(packed, info["num_configs"])
+            except Exception as e:
+                self._crash_wave(jobs_sorted, e, "fused fetch")
+                continue
+            self.fused_brackets_run += 1
+            # stage 0 results feed back immediately; stages >= 1 fill the cache
+            stage0_losses = np.asarray(stages[0][1])
+            for s, (idx, losses) in enumerate(stages[1:], start=1):
+                budget = info["budgets"][s]
+                for i, loss in zip(np.asarray(idx), np.asarray(losses)):
+                    cid = jobs_sorted[int(i)].id
+                    self._fused_cache[(cid, float(budget))] = float(loss)
+            self.logger.debug(
+                "fused bracket %d: %s evals in one dispatch",
+                iteration, sum(len(np.asarray(i)) for i, _ in stages),
+            )
+            for j, loss in zip(jobs_sorted, stage0_losses):
+                self._finish(j, loss)
+        return leftovers
 
     # -------------------------------------------------------------- flush
     def flush(self) -> bool:

@@ -307,6 +307,53 @@ class TestFusedSweep:
         assert len(finite) >= len(runs) // 2
         assert all(np.isfinite(r.loss) for r in finite)
 
+    def test_fused_sweep_on_resnet_workload(self):
+        """BASELINE rung 5 on the fused path (tiny shapes)."""
+        from hpbandster_tpu.workloads import (
+            ResNetConfig,
+            make_resnet_eval_fn,
+            resnet_space,
+        )
+
+        cfg = ResNetConfig(
+            image_size=8, channels=3, width=8, n_classes=4,
+            n_train=64, n_val=32, batch_size=32, groups=4,
+        )
+        cs = resnet_space(seed=0)
+        opt = FusedBOHB(
+            configspace=cs, eval_fn=make_resnet_eval_fn(cfg), run_id="rn-f",
+            min_budget=1, max_budget=4, eta=2, seed=16,
+        )
+        res = opt.run(n_iterations=1)
+        runs = res.get_all_runs()
+        assert len(runs) > 0
+        finite = [r for r in runs if r.loss is not None]
+        assert len(finite) >= len(runs) // 2
+        assert all(np.isfinite(r.loss) for r in finite)
+
+    def test_viz_surface_accepts_fused_result(self):
+        """The matplotlib analysis surface consumes fused Results unchanged."""
+        import matplotlib
+
+        matplotlib.use("Agg")
+        from hpbandster_tpu.viz import (
+            correlation_across_budgets,
+            losses_over_time,
+        )
+
+        cs = branin_space(seed=0)
+        opt = FusedBOHB(
+            configspace=cs, eval_fn=branin_from_vector, run_id="viz-f",
+            min_budget=1, max_budget=9, eta=3, seed=17,
+        )
+        res = opt.run(n_iterations=2)
+        fig, ax = losses_over_time(res.get_all_runs())
+        assert ax.lines or ax.collections
+        correlation_across_budgets(res)
+        # data exports work on fused results too
+        X, y, _ = res.get_fANOVA_data(cs)
+        assert len(X) == len(y) > 0
+
     def test_result_logger_compatible(self, tmp_path):
         from hpbandster_tpu.core.result import (
             json_result_logger,
