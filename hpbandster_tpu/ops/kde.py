@@ -212,6 +212,29 @@ def propose(
     return best, cands, scores
 
 
+def generate_candidates(
+    key: jax.Array,
+    good: KDE,
+    vartypes: jax.Array,
+    cards: jax.Array,
+    total: int,
+    bandwidth_factor: float = 3.0,
+    min_bandwidth: float = 1e-3,
+) -> jax.Array:
+    """``total`` perturbed-good-point candidates, ``f32[total, d]`` — the
+    generation half of the BOHB proposal, shared by the seeded host entry
+    point and the fused-sweep tracer so the sampling scheme has one home."""
+    k_idx, k_samp = jax.random.split(key)
+    logits = jnp.where(good.mask > 0, 0.0, -jnp.inf)
+    idx = jax.random.categorical(k_idx, logits, shape=(total,))
+    keys = jax.random.split(k_samp, total)
+    return jax.vmap(
+        lambda k, x: sample_around(
+            k, x, good.bw, vartypes, cards, bandwidth_factor, min_bandwidth
+        )
+    )(keys, good.data[idx])
+
+
 @partial(jax.jit, static_argnames=("n", "num_samples"))
 def generate_candidates_seeded(
     seed: jax.Array,
@@ -223,21 +246,14 @@ def generate_candidates_seeded(
     bandwidth_factor: float = 3.0,
     min_bandwidth: float = 1e-3,
 ) -> jax.Array:
-    """All ``n * num_samples`` perturbed-good-point candidates for a stage of
-    proposals, flattened to ``f32[n*num_samples, d]`` — the generation half
-    of :func:`propose_batch_seeded`, split out so an external scorer (e.g.
-    the Pallas kernel in ``ops.pallas_kde``) can do the scoring half."""
-    total = n * num_samples
-    key = jax.random.key(seed)
-    k_idx, k_samp = jax.random.split(key)
-    logits = jnp.where(good.mask > 0, 0.0, -jnp.inf)
-    idx = jax.random.categorical(k_idx, logits, shape=(total,))
-    keys = jax.random.split(k_samp, total)
-    return jax.vmap(
-        lambda k, x: sample_around(
-            k, x, good.bw, vartypes, cards, bandwidth_factor, min_bandwidth
-        )
-    )(keys, good.data[idx])
+    """All ``n * num_samples`` candidates for a stage of proposals,
+    flattened to ``f32[n*num_samples, d]`` — :func:`generate_candidates`
+    keyed from one scalar seed (one scalar transfer on high-latency links),
+    so an external scorer (e.g. ``ops.pallas_kde``) can do the scoring half."""
+    return generate_candidates(
+        jax.random.key(seed), good, vartypes, cards, n * num_samples,
+        bandwidth_factor, min_bandwidth,
+    )
 
 
 @partial(jax.jit, static_argnames=("n", "num_samples"))

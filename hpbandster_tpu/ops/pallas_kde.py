@@ -30,7 +30,11 @@ import numpy as np
 
 from hpbandster_tpu.ops.kde import KDE, LOG_PDF_FLOOR
 
-__all__ = ["pallas_score_candidates", "pallas_available"]
+__all__ = [
+    "pallas_score_candidates",
+    "pallas_score_candidates_traced",
+    "pallas_available",
+]
 
 _LOG_SQRT_2PI = 0.5 * math.log(2.0 * math.pi)
 _TILE_S = 128
@@ -161,6 +165,52 @@ def _score_padded(
         out_specs=spec((_TILE_S, 1), lambda i: (i, 0)),
         interpret=interpret,
     )(cands, goodT, gmask, gbw, badT, bmask, bbw, vt, cards)
+
+
+def pallas_score_candidates_traced(
+    cands: jax.Array,
+    good: KDE,
+    bad: KDE,
+    vartypes: jax.Array,
+    cards: jax.Array,
+    interpret: bool = False,
+) -> jax.Array:
+    """Trace-safe twin of :func:`pallas_score_candidates`: all padding is
+    jnp (static shapes), so the scorer can live INSIDE a larger jitted
+    program — e.g. the fused whole-sweep (``ops/sweep.py``)."""
+    cands = cands.astype(jnp.float32)
+    s, d = cands.shape
+    s_pad = ((s + _TILE_S - 1) // _TILE_S) * _TILE_S
+    d_pad = _LANE
+
+    def prep(kde: KDE):
+        data = kde.data.astype(jnp.float32)
+        n = data.shape[0]
+        n_pad = ((n + _LANE - 1) // _LANE) * _LANE
+        dataT = jnp.zeros((d_pad, n_pad), jnp.float32).at[:d, :n].set(data.T)
+        mask2 = jnp.zeros((1, n_pad), jnp.float32).at[0, :n].set(
+            kde.mask.astype(jnp.float32)
+        )
+        bw2 = jnp.ones((1, d_pad), jnp.float32).at[0, :d].set(
+            kde.bw.astype(jnp.float32)
+        )
+        return dataT, mask2, bw2
+
+    goodT, gmask, gbw = prep(good)
+    badT, bmask, bbw = prep(bad)
+    vt = jnp.full((1, d_pad), 3.0, jnp.float32).at[0, :d].set(
+        jnp.asarray(vartypes, jnp.float32)
+    )
+    cd = jnp.ones((1, d_pad), jnp.float32).at[0, :d].set(
+        jnp.asarray(cards, jnp.float32)
+    )
+    cpad = jnp.zeros((s_pad, d_pad), jnp.float32).at[:s, :d].set(cands)
+
+    out = _score_padded(
+        cpad, goodT, gmask, gbw, badT, bmask, bbw, vt, cd,
+        d_actual=d, interpret=interpret,
+    )
+    return out[:s, 0]
 
 
 def pallas_score_candidates(
