@@ -206,25 +206,22 @@ class BOHBKDE(base_config_generator):
         return KDE(padded, mask, bw)
 
     def _propose_batch_pallas(self, seed, good, bad, n: int) -> np.ndarray:
-        """Pallas-scored proposals: generation + scoring split so the fused
-        TPU kernel handles both KDE log-pdfs and the acquisition ratio."""
-        from hpbandster_tpu.ops.kde import generate_candidates_seeded
-        from hpbandster_tpu.ops.pallas_kde import pallas_score_candidates
-
-        from hpbandster_tpu.ops.pallas_kde import pallas_available
-
-        cands = generate_candidates_seeded(
-            seed, good, self._vartypes_dev, self._cards_dev, n, self.num_samples,
-            self.bandwidth_factor, self.min_bandwidth,
+        """Pallas-scored proposals via the shared traced pipeline
+        (``ops.pallas_kde.pallas_propose_batch_seeded``): generation,
+        fused-kernel scoring and the per-proposal argmax all stay on device;
+        only the selected ``[n, d]`` vectors transfer back."""
+        from hpbandster_tpu.ops.pallas_kde import (
+            pallas_available,
+            pallas_propose_batch_seeded,
         )
-        scores = pallas_score_candidates(
-            cands, good, bad, self._vartypes_dev, self._cards_dev,
-            interpret=not pallas_available(),  # CPU tests run interpreted
+
+        return np.asarray(
+            pallas_propose_batch_seeded(
+                seed, good, bad, self._vartypes_dev, self._cards_dev, n,
+                self.num_samples, self.bandwidth_factor, self.min_bandwidth,
+                interpret=not pallas_available(),  # CPU tests run interpreted
+            )
         )
-        scores = np.asarray(scores).reshape(n, self.num_samples)
-        cands = np.asarray(cands).reshape(n, self.num_samples, -1)
-        best = scores.argmax(axis=1)
-        return cands[np.arange(n), best]
 
     # ----------------------------------------------------------- checkpoint
     def get_state(self) -> Dict[str, Any]:

@@ -33,6 +33,8 @@ from hpbandster_tpu.ops.kde import KDE, LOG_PDF_FLOOR
 __all__ = [
     "pallas_score_candidates",
     "pallas_score_candidates_traced",
+    "pallas_propose_batch",
+    "pallas_propose_batch_seeded",
     "pallas_available",
 ]
 
@@ -211,6 +213,64 @@ def pallas_score_candidates_traced(
         d_actual=d, interpret=interpret,
     )
     return out[:s, 0]
+
+
+def pallas_propose_batch(
+    key: jax.Array,
+    good: KDE,
+    bad: KDE,
+    vartypes: jax.Array,
+    cards: jax.Array,
+    n: int,
+    num_samples: int = 64,
+    bandwidth_factor: float = 3.0,
+    min_bandwidth: float = 1e-3,
+    interpret: bool = False,
+) -> jax.Array:
+    """A whole stage of BOHB proposals with Pallas-scored acquisition:
+    generate ``n * num_samples`` candidates (``ops.kde.generate_candidates``),
+    score them in the fused kernel, return the per-proposal argmax —
+    ``f32[n, d]``, fully trace-safe (the fused sweep calls this inside its
+    program; the host path wraps it via :func:`pallas_propose_batch_seeded`).
+
+    RNG stream differs from the per-proposal :func:`ops.kde.propose` path
+    (one flat candidate draw instead of per-proposal splits) — same
+    distribution, different numbers.
+    """
+    from hpbandster_tpu.ops.kde import generate_candidates
+
+    cands = generate_candidates(
+        key, good, vartypes, cards, n * num_samples,
+        bandwidth_factor, min_bandwidth,
+    )
+    scores = pallas_score_candidates_traced(
+        cands, good, bad, vartypes, cards, interpret=interpret
+    ).reshape(n, num_samples)
+    best = jnp.argmax(scores, axis=1)
+    return cands.reshape(n, num_samples, -1)[jnp.arange(n), best]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n", "num_samples", "interpret")
+)
+def pallas_propose_batch_seeded(
+    seed: jax.Array,
+    good: KDE,
+    bad: KDE,
+    vartypes: jax.Array,
+    cards: jax.Array,
+    n: int,
+    num_samples: int = 64,
+    bandwidth_factor: float = 3.0,
+    min_bandwidth: float = 1e-3,
+    interpret: bool = False,
+) -> jax.Array:
+    """:func:`pallas_propose_batch` keyed from one scalar seed (same key
+    derivation as ``ops.kde.generate_candidates_seeded``)."""
+    return pallas_propose_batch(
+        jax.random.key(seed), good, bad, vartypes, cards, n, num_samples,
+        bandwidth_factor, min_bandwidth, interpret,
+    )
 
 
 def pallas_score_candidates(

@@ -232,39 +232,6 @@ class SweepBracketOutput(NamedTuple):
     loss_packed: jax.Array
 
 
-def _propose_batch_pallas(
-    key: jax.Array,
-    good: KDE,
-    bad: KDE,
-    vartypes: jax.Array,
-    cards: jax.Array,
-    n: int,
-    num_samples: int,
-    bandwidth_factor: float,
-    min_bandwidth: float,
-    interpret: bool,
-) -> jax.Array:
-    """Candidate generation (pure JAX) + acquisition scoring (Pallas TPU
-    kernel) for a whole stage of proposals, trace-safe inside the sweep.
-
-    RNG stream differs from the per-proposal :func:`ops.kde.propose` path
-    (one flat candidate draw instead of per-proposal splits) — same
-    distribution, different numbers, matching the per-bracket Pallas path.
-    """
-    from hpbandster_tpu.ops.kde import generate_candidates
-    from hpbandster_tpu.ops.pallas_kde import pallas_score_candidates_traced
-
-    cands = generate_candidates(
-        key, good, vartypes, cards, n * num_samples,
-        bandwidth_factor, min_bandwidth,
-    )
-    scores = pallas_score_candidates_traced(
-        cands, good, bad, vartypes, cards, interpret=interpret
-    ).reshape(n, num_samples)
-    best = jnp.argmax(scores, axis=1)
-    return cands.reshape(n, num_samples, -1)[jnp.arange(n), best]
-
-
 def _fit_kde_pair_device(
     vecs: jax.Array,
     losses: jax.Array,
@@ -383,7 +350,9 @@ def make_fused_sweep_fn(
                     n_good, n_bad, cards_dev, min_bandwidth,
                 )
                 if use_pallas:
-                    model_vecs = _propose_batch_pallas(
+                    from hpbandster_tpu.ops.pallas_kde import pallas_propose_batch
+
+                    model_vecs = pallas_propose_batch(
                         k_prop, good, bad, vartypes_dev, cards_dev, n0,
                         num_samples, bandwidth_factor, min_bandwidth,
                         pallas_interpret,

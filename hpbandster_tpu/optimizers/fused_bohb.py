@@ -236,14 +236,24 @@ class FusedBOHB:
         n_iterations: int = 1,
         min_n_workers: int = 1,
         profile_dir: Optional[str] = None,
+        chunk_brackets: Optional[int] = None,
     ) -> Result:
-        """Run brackets as one fused device computation.
+        """Run brackets as fused device computation(s).
 
         ``n_iterations`` is the TOTAL bracket count including previous
         ``run()`` calls on this instance (Master.run's resume semantics):
         a second call only runs the remaining brackets, continuing the
-        HyperBand bracket rotation. Each call is its own fused computation —
-        device-side model state does not carry across calls.
+        HyperBand bracket rotation — and its proposals see all earlier
+        results (they thread into the next computation as warm data).
+
+        ``chunk_brackets=None`` (default) compiles the whole remaining
+        schedule into ONE program. Setting it to K runs the schedule in
+        fused chunks of K brackets, threading the accumulated observations
+        into each next chunk as warm data (identical model information, in
+        stage-chunked form) — bounding program size for very long sweeps,
+        streaming results (and ``result_logger`` lines) after every chunk,
+        and leaving completed chunks' results intact if a later chunk dies.
+
         ``profile_dir`` captures a ``jax.profiler`` trace of the sweep
         (TensorBoard/Perfetto-viewable).
         """
@@ -257,31 +267,55 @@ class FusedBOHB:
         if self.config["time_ref"] is None:
             self.config["time_ref"] = time.time()
 
-        if plans:
+        chunk = len(plans) if chunk_brackets is None else max(int(chunk_brackets), 1)
+        done = first
+        while plans:
+            chunk_plans, plans = plans[:chunk], plans[chunk:]
             seed = np.uint32(self.rng.integers(2**32, dtype=np.uint32))
             with trace(profile_dir):
                 if self._warm_l:
-                    outputs = self._sweep_fn(tuple(plans))(
+                    outputs = self._sweep_fn(tuple(chunk_plans))(
                         seed, self._warm_v, self._warm_l
                     )
                 else:
-                    outputs = self._sweep_fn(tuple(plans))(seed)
+                    outputs = self._sweep_fn(tuple(chunk_plans))(seed)
                 outputs = jax.device_get(outputs)
-            for b_i, (plan, out) in enumerate(zip(plans, outputs), start=first):
-                self._replay_bracket(b_i, plan, out)
+            from hpbandster_tpu.ops.fused import _unpack_stages
+
+            for b_i, (plan, out) in enumerate(zip(chunk_plans, outputs), start=done):
+                stages = _unpack_stages(
+                    (out.idx_packed, out.loss_packed), plan.num_configs
+                )
+                self._replay_bracket(b_i, plan, out, stages)
+                # later chunks AND later run() calls consume these as warm
+                # data — the model, like the Master's, sees all past results
+                self._accumulate_obs(plan, out, stages)
+            done += len(chunk_plans)
         return Result(
             list(self.iterations) + self.warmstart_iteration, self.config
         )
 
-    # --------------------------------------------------------------- replay
-    def _replay_bracket(self, b_i: int, plan, out) -> None:
-        from hpbandster_tpu.ops.fused import _unpack_stages
+    def _accumulate_obs(self, plan, out, stages) -> None:
+        """Fold one replayed bracket's (vector, loss) observations into the
+        warm buffers so the next chunk's device model sees them."""
+        vectors = np.asarray(out.vectors)
+        for (idx_s, losses_s), budget in zip(stages, plan.budgets):
+            b = float(budget)
+            vecs = vectors[np.asarray(idx_s)]
+            losses = np.where(
+                np.isnan(losses_s), np.inf, losses_s
+            ).astype(np.float32)
+            if b in self._warm_v:
+                self._warm_v[b] = np.concatenate([self._warm_v[b], vecs])
+                self._warm_l[b] = np.concatenate([self._warm_l[b], losses])
+            else:
+                self._warm_v[b] = vecs.astype(np.float32)
+                self._warm_l[b] = losses
 
+    # --------------------------------------------------------------- replay
+    def _replay_bracket(self, b_i: int, plan, out, stages) -> None:
         vectors = np.asarray(out.vectors)
         mb_mask = np.asarray(out.model_based)
-        stages = _unpack_stages(
-            (out.idx_packed, out.loss_packed), plan.num_configs
-        )
         promotion_sets = [set(int(i) for i in idx) for idx, _ in stages[1:]]
         promotion_sets.append(set())
 

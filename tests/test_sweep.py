@@ -457,6 +457,44 @@ class TestFusedSweep:
         assert inf_runs, "expected some diverged (+inf) runs"
         assert all(r.loss is not None for r in runs)
 
+    def test_chunked_run_matches_structure_and_carries_model(self):
+        """chunk_brackets=K: same SH arithmetic as the monolithic program,
+        and later chunks' proposals are model-based (obs threaded through
+        as warm data)."""
+        cs = branin_space(seed=0)
+        opt = FusedBOHB(
+            configspace=cs, eval_fn=branin_from_vector, run_id="chunk",
+            min_budget=1, max_budget=27, eta=3, seed=24,
+        )
+        res = opt.run(n_iterations=4, chunk_brackets=2)
+        plans = hyperband_schedule(4, 1, 27, 3)
+        runs = res.get_all_runs()
+        assert len(runs) == sum(p.total_evaluations for p in plans)
+        id2conf = res.get_id2config_mapping()
+        # chunk 2 (brackets 2-3) must see chunk 1's observations
+        mb_late = [
+            cid for cid, c in id2conf.items()
+            if cid[0] >= 2 and c["config_info"].get("model_based_pick")
+        ]
+        assert mb_late, "second chunk made no model-based picks"
+
+    def test_second_run_call_is_model_warm(self):
+        """Master-parity: a later run() call's proposals see all earlier
+        results from this instance."""
+        cs = branin_space(seed=0)
+        opt = FusedBOHB(
+            configspace=cs, eval_fn=branin_from_vector, run_id="rr",
+            min_budget=1, max_budget=27, eta=3, seed=25,
+        )
+        opt.run(n_iterations=2)
+        res = opt.run(n_iterations=3)
+        id2conf = res.get_id2config_mapping()
+        mb_third = [
+            cid for cid, c in id2conf.items()
+            if cid[0] == 2 and c["config_info"].get("model_based_pick")
+        ]
+        assert mb_third, "third bracket ignored earlier results"
+
     def test_warmstart_from_previous_result(self):
         """previous_result= seeds the device observation buffers: bracket 0
         of the warm run can already make model-based picks, and the old data
