@@ -38,6 +38,7 @@ _EXPORTS = {
     "FusedBOHB": "hpbandster_tpu.optimizers",
     "FusedHyperBand": "hpbandster_tpu.optimizers",
     "FusedRandomSearch": "hpbandster_tpu.optimizers",
+    "FusedH2BO": "hpbandster_tpu.optimizers",
 }
 
 

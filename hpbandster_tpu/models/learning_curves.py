@@ -39,9 +39,14 @@ class PowerLawModel:
     Fit by log-linear regression on differences from the running minimum;
     degenerate curves (fewer than 3 points, non-decreasing) fall back to
     last-value.
+
+    ``floor`` is a LOWER BOUND on the asymptote clamp ``ymin - c``: the
+    effective offset is ``max(floor, |ymin| * 1e-5)``, scale-aware so the
+    f32 device twin (``ops.bracket.power_law_extrapolate``) can represent
+    the identical quantity — passing a tinier floor cannot tighten it.
     """
 
-    def __init__(self, floor: float = 1e-12):
+    def __init__(self, floor: float = 1e-6):
         self.floor = floor
 
     def fit(self, curves: List[Curve]) -> "PowerLawModel":
@@ -59,7 +64,10 @@ class PowerLawModel:
         y0, y1, y2 = y[-3], y[-2], y[-1]
         denom = y0 + y2 - 2 * y1
         c_est = (y0 * y2 - y1 * y1) / denom if abs(denom) > 1e-12 else -np.inf
-        c = min(c_est, y.min() - self.floor) if np.isfinite(c_est) else y.min() - self.floor
+        # scale-aware floor so the device (f32) twin in ops.bracket can
+        # represent the same offset: ymin - 1e-12 is a no-op in f32
+        floor = max(self.floor, abs(y.min()) * 1e-5)
+        c = min(c_est, y.min() - floor) if np.isfinite(c_est) else y.min() - floor
         resid = y - c
         if (resid <= 0).any() or (np.diff(y) > 0).all():
             return LastValueModel().predict(curve, target_budget)

@@ -26,6 +26,7 @@ __all__ = [
     "sh_promotion_mask",
     "sh_promotion_mask_np",
     "sh_resample_mask",
+    "power_law_extrapolate",
 ]
 
 
@@ -134,6 +135,56 @@ def sh_promotion_mask_np(losses: np.ndarray, k) -> np.ndarray:
     clean = np.where(np.isnan(losses), np.float32(np.inf), losses)
     ranks = np.argsort(np.argsort(clean, kind="stable"), kind="stable")
     return ranks < k
+
+
+def power_law_extrapolate(
+    budgets: jax.Array, losses: jax.Array, target_budget: float,
+    floor: float = 1e-6,
+) -> jax.Array:
+    """Jittable twin of ``models.learning_curves.PowerLawModel.predict``,
+    vectorized over configs: ``budgets f32[s]`` (ascending), ``losses
+    f32[n, s]`` -> extrapolated loss at ``target_budget``, ``f32[n]``.
+
+    Fallback semantics mirror the host model exactly: fewer than 3 points,
+    non-positive residuals, all-increasing curves, or a positive slope fall
+    back to the last observed value. The on-device H2BO promotion
+    (``FusedH2BO``) ranks by these scores.
+    """
+    budgets = jnp.asarray(budgets, jnp.float32)
+    losses = jnp.asarray(losses, jnp.float32)
+    n, s = losses.shape
+    last = losses[:, -1]
+    if s < 3:
+        return last
+
+    y0, y1, y2 = losses[:, -3], losses[:, -2], losses[:, -1]
+    denom = y0 + y2 - 2.0 * y1
+    c_est = jnp.where(
+        jnp.abs(denom) > 1e-12, (y0 * y2 - y1 * y1) / denom, -jnp.inf
+    )
+    ymin = losses.min(axis=1)
+    # scale-aware floor (twin of PowerLawModel.predict): a fixed 1e-12 is
+    # not representable next to f32 values of order 1
+    floor_eff = jnp.maximum(floor, jnp.abs(ymin) * 1e-5)
+    c = jnp.where(
+        jnp.isfinite(c_est),
+        jnp.minimum(c_est, ymin - floor_eff),
+        ymin - floor_eff,
+    )
+    resid = losses - c[:, None]
+    bad = (resid <= 0).any(axis=1) | (jnp.diff(losses, axis=1) > 0).all(axis=1)
+
+    log_b = jnp.log(budgets)[None, :]
+    log_r = jnp.log(jnp.maximum(resid, 1e-30))
+    mb = log_b.mean(axis=1)
+    mr = log_r.mean(axis=1)
+    cov = ((log_b - mb[:, None]) * (log_r - mr[:, None])).mean(axis=1)
+    var = jnp.maximum(((log_b - mb[:, None]) ** 2).mean(axis=1), 1e-30)
+    slope = cov / var
+    intercept = mr - slope * mb
+    bad = bad | (slope > 0)
+    pred = c + jnp.exp(intercept + slope * jnp.log(jnp.float32(target_budget)))
+    return jnp.where(bad, last, pred)
 
 
 def sh_resample_mask(

@@ -34,6 +34,7 @@ def fused_sh_bracket(
     vectors: jax.Array,
     num_configs: Sequence[int],
     budgets: Sequence[float],
+    rank_fn: Callable[[jax.Array, jax.Array, float], jax.Array] = None,
 ) -> List[Tuple[jax.Array, jax.Array]]:
     """Trace one whole bracket. Returns per-stage ``(indices, losses)``
     where ``indices`` index the original (unpadded) stage-0 rows.
@@ -41,6 +42,12 @@ def fused_sh_bracket(
     ``vectors`` may carry extra padding rows beyond ``num_configs[0]`` (for
     mesh divisibility); they are evaluated but can never be promoted. Must
     run under ``jit`` (see :func:`make_fused_bracket_fn`).
+
+    ``rank_fn(budgets_so_far f32[s+1], history f32[n_cur, s+1],
+    final_budget) -> scores f32[n_cur]`` overrides the promotion scores
+    (lower = better; NaN = never promote). Default: the current stage's raw
+    losses — plain successive halving. ``FusedH2BO`` passes the power-law
+    learning-curve extrapolation here.
     """
     n0 = int(num_configs[0])
     n_rows = vectors.shape[0]
@@ -50,13 +57,33 @@ def fused_sh_bracket(
     def eval_stage(vecs: jax.Array, budget: float) -> jax.Array:
         return jax.vmap(lambda v: eval_fn(v, budget))(vecs).astype(jnp.float32)
 
-    def rank_key(losses: jax.Array, is_pad: jax.Array) -> jax.Array:
-        key = jnp.where(jnp.isnan(losses), _CRASH_RANK, losses)
+    def rank_key(scores: jax.Array, is_pad: jax.Array) -> jax.Array:
+        key = jnp.where(jnp.isnan(scores), _CRASH_RANK, scores)
         return jnp.where(is_pad, jnp.inf, key)
+
+    def scores_for(history_cols: List[jax.Array], s: int) -> jax.Array:
+        """Promotion scores after stage ``s`` from the survivors' loss
+        history ``[n_cur, s+1]``; crashed (NaN-loss) configs stay NaN."""
+        hist = jnp.stack(history_cols, axis=1)
+        if rank_fn is None or s == 0:
+            scores = hist[:, -1]
+        else:
+            scores = rank_fn(
+                jnp.asarray(budgets[: s + 1], jnp.float32), hist,
+                float(budgets[-1]),
+            )
+            # host H2BO parity (optimizers/h2bo.py): where extrapolation is
+            # undefined (e.g. an earlier-stage crash left NaN in the
+            # history), fall back to the raw current-stage loss ...
+            scores = jnp.where(jnp.isnan(scores), hist[:, -1], scores)
+            # ... and a crashed CURRENT stage dominates any extrapolation
+            scores = jnp.where(jnp.isnan(hist[:, -1]), jnp.nan, scores)
+        return scores
 
     losses0 = eval_stage(vectors, float(budgets[0]))
     cur_idx = jnp.arange(n_rows, dtype=jnp.int32)
-    cur_key = rank_key(losses0, cur_idx >= n0)
+    history = [losses0]  # per-stage losses of the CURRENT survivor set
+    cur_key = rank_key(scores_for(history, 0), cur_idx >= n0)
     out = [(jnp.arange(n0, dtype=jnp.int32), losses0[:n0])]
 
     for s in range(1, len(num_configs)):
@@ -67,7 +94,10 @@ def fused_sh_bracket(
         sel_vecs = vectors[sel_idx]
         losses_s = eval_stage(sel_vecs, float(budgets[s]))
         cur_idx = sel_idx
-        cur_key = rank_key(losses_s, jnp.zeros_like(sel_idx, dtype=bool))
+        history = [col[top] for col in history] + [losses_s]
+        cur_key = rank_key(
+            scores_for(history, s), jnp.zeros_like(sel_idx, dtype=bool)
+        )
         out.append((cur_idx, losses_s))
     return out
 

@@ -272,6 +272,7 @@ def make_fused_sweep_fn(
     warm_counts: Optional[dict] = None,
     use_pallas: bool = False,
     pallas_interpret: bool = False,
+    rank_fn: Optional[Callable] = None,
 ) -> Callable[..., List[SweepBracketOutput]]:
     """Trace + jit the whole sweep; returns ``fn(seed[, warm_v, warm_l])``.
 
@@ -376,7 +377,9 @@ def make_fused_sweep_fn(
                     vectors, NamedSharding(mesh, PartitionSpec(axis))
                 )
 
-            stages = fused_sh_bracket(eval_fn, vectors, plan.num_configs, plan.budgets)
+            stages = fused_sh_bracket(
+                eval_fn, vectors, plan.num_configs, plan.budgets, rank_fn=rank_fn
+            )
 
             for (idx_s, losses_s), k_s, budget in zip(
                 stages, plan.num_configs, plan.budgets

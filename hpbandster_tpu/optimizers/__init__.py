@@ -6,6 +6,7 @@ from hpbandster_tpu.optimizers.randomsearch import RandomSearch  # noqa: F401
 from hpbandster_tpu.optimizers.h2bo import H2BO  # noqa: F401
 from hpbandster_tpu.optimizers.fused_bohb import (  # noqa: F401
     FusedBOHB,
+    FusedH2BO,
     FusedHyperBand,
     FusedRandomSearch,
 )

@@ -35,7 +35,7 @@ from hpbandster_tpu.ops.sweep import build_space_codec, make_fused_sweep_fn
 from hpbandster_tpu.space import ConfigurationSpace
 from hpbandster_tpu.utils.lru import LRUCache
 
-__all__ = ["FusedBOHB", "FusedHyperBand", "FusedRandomSearch"]
+__all__ = ["FusedBOHB", "FusedHyperBand", "FusedRandomSearch", "FusedH2BO"]
 
 #: process-wide compiled-sweep cache (same policy as the fused-bracket and
 #: batch caches: one compile per (objective, schedule, space, knobs, mesh))
@@ -144,6 +144,9 @@ class FusedBOHB:
         }
         #: stats for tests/benchmarks
         self.total_evaluated = 0
+        #: optional on-device promotion scorer (see FusedH2BO); None = the
+        #: plain successive-halving raw-loss top-k
+        self.promotion_rank_fn = None
 
         # warm start (reference: previous_result= replays old data into the
         # model, SURVEY.md §5): old (config, budget, loss) observations seed
@@ -209,6 +212,7 @@ class FusedBOHB:
             tuple(sorted(warm_counts.items())),
             self.use_pallas,
             self.pallas_interpret,
+            self.promotion_rank_fn,
         )
         fn = _SWEEP_FN_CACHE.get(key)
         if fn is None:
@@ -227,6 +231,7 @@ class FusedBOHB:
                 warm_counts=warm_counts,
                 use_pallas=self.use_pallas,
                 pallas_interpret=self.pallas_interpret,
+                rank_fn=self.promotion_rank_fn,
             )
             _SWEEP_FN_CACHE[key] = fn
         return fn
@@ -387,6 +392,20 @@ class FusedHyperBand(FusedBOHB):
         kwargs["random_fraction"] = 1.0
         kwargs["min_points_in_model"] = 2**30
         super().__init__(*args, **kwargs)
+
+
+class FusedH2BO(FusedBOHB):
+    """H2BO on the fused path: promotions rank by an ON-DEVICE power-law
+    learning-curve extrapolation of each config's loss to the bracket's
+    final budget (``ops.bracket.power_law_extrapolate``, the jittable twin
+    of ``models.learning_curves.PowerLawModel``) instead of the raw
+    current-stage loss; KDE proposals are unchanged BOHB."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        from hpbandster_tpu.ops.bracket import power_law_extrapolate
+
+        self.promotion_rank_fn = power_law_extrapolate
 
 
 class FusedRandomSearch(FusedHyperBand):

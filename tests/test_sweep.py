@@ -563,6 +563,105 @@ class TestFusedSweep:
             c["config_info"].get("model_based_pick") for c in id2conf.values()
         )
 
+    def test_power_law_extrapolate_matches_host_model(self):
+        from hpbandster_tpu.models.learning_curves import PowerLawModel
+        from hpbandster_tpu.ops.bracket import power_law_extrapolate
+
+        rng = np.random.default_rng(7)
+        budgets = np.array([1.0, 3.0, 9.0], np.float32)
+        host = PowerLawModel()
+        # mix of decaying power-law curves and degenerate/increasing curves
+        curves = []
+        for _ in range(40):
+            kind = rng.integers(3)
+            if kind == 0:  # clean power law
+                a, k, c = rng.uniform(0.5, 5), rng.uniform(0.2, 2), rng.uniform(0, 1)
+                curves.append(a * budgets ** (-k) + c)
+            elif kind == 1:  # increasing (diverging) curve
+                curves.append(np.sort(rng.uniform(0, 5, size=3)))
+            else:  # noisy arbitrary
+                curves.append(rng.uniform(0, 5, size=3))
+        losses = np.stack(curves).astype(np.float32)
+        dev = np.asarray(power_law_extrapolate(budgets, losses, 27.0))
+        for i in range(len(curves)):
+            expect = host.predict(list(zip(budgets, losses[i])), 27.0)
+            # f32 device fit vs f64 host fit: a few percent of slack
+            np.testing.assert_allclose(
+                dev[i], expect, rtol=5e-2, atol=2e-2, err_msg=f"curve {i}"
+            )
+
+    def test_power_law_short_history_falls_back_to_last(self):
+        from hpbandster_tpu.ops.bracket import power_law_extrapolate
+
+        budgets = np.array([1.0, 3.0], np.float32)
+        losses = np.array([[5.0, 2.0], [1.0, 4.0]], np.float32)
+        out = np.asarray(power_law_extrapolate(budgets, losses, 9.0))
+        np.testing.assert_allclose(out, [2.0, 4.0])
+
+    def test_fused_h2bo_promotes_by_extrapolation(self):
+        """On an objective where curves cross, FusedH2BO's promotions
+        differ from raw top-k while the structure stays intact."""
+        from hpbandster_tpu.optimizers import FusedH2BO
+        import jax.numpy as jnp
+
+        def crossing(vec, budget):
+            # a = initial level, k = decay speed: fast decayers start worse
+            # but win at high budget
+            a = 1.0 + vec[0] * 10.0
+            k = 0.1 + vec[1] * 2.0
+            return a * budget ** (-k)
+
+        cs = branin_space(seed=0)
+        kwargs = dict(
+            configspace=cs, eval_fn=crossing,
+            min_budget=1, max_budget=81, eta=3, seed=30,
+        )
+        res_h2 = FusedH2BO(run_id="h2", **kwargs).run(n_iterations=1)
+        res_sh = FusedBOHB(run_id="sh", **kwargs).run(n_iterations=1)
+
+        def promoted_at(res, budget):
+            return {r.config_id for r in res.get_all_runs() if r.budget == budget}
+
+        # same stage-0 proposals (identical seed/rng stream) ...
+        assert promoted_at(res_h2, 1.0) == promoted_at(res_sh, 1.0)
+        # ... but the bracket structure holds for both
+        plans = hyperband_schedule(1, 1, 81, 3)
+        assert len(res_h2.get_all_runs()) == plans[0].total_evaluations
+        assert len(res_sh.get_all_runs()) == plans[0].total_evaluations
+        # and at least one later-stage promotion set differs (curves cross)
+        later = [b for b in plans[0].budgets[2:]]
+        assert any(
+            promoted_at(res_h2, b) != promoted_at(res_sh, b) for b in later
+        ), "LC extrapolation never changed a promotion on a crossing objective"
+
+    def test_fused_h2bo_recovers_from_earlier_stage_crash(self):
+        """A config whose stage-0 eval crashed but was promoted anyway (not
+        enough clean survivors) must be ranked by merit at later stages,
+        not crash-ranked forever (host H2BO parity)."""
+        from hpbandster_tpu.optimizers import FusedH2BO
+        import jax.numpy as jnp
+
+        def flaky_at_1(vec, budget):
+            # everything crashes at budget 1; later budgets give clean,
+            # config-dependent losses
+            return jnp.where(budget < 2.0, jnp.nan, vec[0] / budget)
+
+        cs = branin_space(seed=0)
+        opt = FusedH2BO(
+            configspace=cs, eval_fn=flaky_at_1, run_id="h2-crash",
+            min_budget=1, max_budget=9, eta=3, seed=31,
+        )
+        res = opt.run(n_iterations=1)  # bracket (9,3,1)@(1,3,9)
+        runs = res.get_all_runs()
+        at9 = [r for r in runs if r.budget == 9.0]
+        assert len(at9) == 1
+        # the final promotion ranked the clean budget-3 losses by merit:
+        # the winner's loss must be the minimum of the stage-3 losses
+        at3 = {r.config_id: r.loss for r in runs if r.budget == 3.0}
+        assert all(v is not None for v in at3.values())
+        winner = at9[0].config_id
+        assert at3[winner] == min(at3.values())
+
     def test_fused_randomsearch_single_stage_at_max_budget(self):
         from hpbandster_tpu.optimizers import FusedRandomSearch
 
