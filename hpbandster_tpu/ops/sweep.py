@@ -37,7 +37,7 @@ from hpbandster_tpu.ops.fused import fused_sh_bracket, _pack_stages
 from hpbandster_tpu.ops.kde import KDE, normal_reference_bandwidths, propose
 
 __all__ = ["SpaceCodec", "build_space_codec", "quantize_unit", "random_unit",
-           "make_fused_sweep_fn", "SweepBracketOutput"]
+           "compile_active_mask", "make_fused_sweep_fn", "SweepBracketOutput"]
 
 
 class SpaceCodec(NamedTuple):
@@ -70,7 +70,8 @@ class SpaceCodec(NamedTuple):
 
 def build_space_codec(configspace) -> SpaceCodec:
     """Extract the static codec; raises ``ValueError`` for spaces the fused
-    sweep cannot represent (conditions, forbiddens)."""
+    sweep cannot represent (forbidden clauses; conditions are supported via
+    :func:`compile_active_mask`)."""
     from hpbandster_tpu.space.hyperparameters import (
         CategoricalHyperparameter,
         Constant,
@@ -79,10 +80,10 @@ def build_space_codec(configspace) -> SpaceCodec:
         UniformIntegerHyperparameter,
     )
 
-    if configspace.get_conditions() or configspace.get_forbiddens():
+    if configspace.get_forbiddens():
         raise ValueError(
-            "fused sweep supports condition-free, forbidden-free spaces; "
-            "use the per-bracket batched path for conditional spaces"
+            "fused sweep supports forbidden-free spaces; "
+            "use the per-bracket batched path for forbidden clauses"
         )
     hps = configspace.get_hyperparameters()
     d = len(hps)
@@ -219,6 +220,168 @@ def random_unit(codec: SpaceCodec, key: jax.Array, n: int) -> jax.Array:
     return out
 
 
+def _decode_values(codec: SpaceCodec, q: jax.Array) -> jax.Array:
+    """Decode one quantized unit vector to the numeric values conditions
+    compare against: floats/ints to their real value, categorical/ordinal
+    dims to their choice INDEX (value-level comparisons are resolved to
+    indices at compile time), constants to 0."""
+    kind = jnp.asarray(codec.kind)
+    lo = jnp.asarray(codec.lower, jnp.float32)
+    hi = jnp.asarray(codec.upper, jnp.float32)
+    log_lo = jnp.log(jnp.maximum(lo, 1e-30))
+    log_hi = jnp.log(jnp.maximum(hi, 1e-30))
+    v_lin = lo + q * (hi - lo)
+    v_log = jnp.exp(log_lo + q * (log_hi - log_lo))
+    v_float = jnp.where(jnp.asarray(codec.log), v_log, v_lin)
+
+    ilo, ihi = _int_log_bounds(codec)
+    ilo = jnp.asarray(ilo, jnp.float32)
+    ihi = jnp.asarray(ihi, jnp.float32)
+    n_int = jnp.maximum(hi - lo + 1.0, 1.0)
+    vi_lin = lo - 0.5 + q * n_int
+    vi_log = jnp.exp(
+        jnp.log(jnp.maximum(ilo, 1e-30))
+        + q * (jnp.log(jnp.maximum(ihi, 1e-30)) - jnp.log(jnp.maximum(ilo, 1e-30)))
+    )
+    v_int = jnp.clip(
+        jnp.round(jnp.where(jnp.asarray(codec.log), vi_log, vi_lin)), lo, hi
+    )
+
+    out = jnp.where(kind == 0, v_float, q)
+    out = jnp.where(kind == 1, v_int, out)
+    out = jnp.where(kind == 2, jnp.round(q), out)
+    out = jnp.where(kind == 3, 0.0, out)
+    return out
+
+
+def compile_active_mask(configspace, codec: SpaceCodec):
+    """Compile the space's condition DAG to a jittable activity predicate.
+
+    Returns ``mask_fn(q: f32[d]) -> bool[d]`` (vmap over batches) deciding,
+    from a QUANTIZED unit vector, which dims are conditionally active —
+    the device twin of ``ConfigurationSpace._active_set`` (a child is
+    active iff every condition on it holds, and a condition on an inactive
+    parent is false). Raises ``ValueError`` for condition forms without a
+    numeric device representation (e.g. order comparisons on non-numeric
+    ordinals) — callers fall back to the per-bracket path.
+    """
+    from hpbandster_tpu.space.conditions import (
+        AndConjunction,
+        EqualsCondition,
+        GreaterThanCondition,
+        InCondition,
+        LessThanCondition,
+        NotEqualsCondition,
+        OrConjunction,
+    )
+    from hpbandster_tpu.space.hyperparameters import (
+        CategoricalHyperparameter,
+        Constant,
+        OrdinalHyperparameter,
+    )
+
+    hps = configspace.get_hyperparameters()
+    names = configspace.get_hyperparameter_names()
+    index = {n: i for i, n in enumerate(names)}
+    hp_by_name = dict(zip(names, hps))
+
+    def cond_value_to_number(parent_name: str, value) -> float:
+        """Resolve a condition's comparison value to the decoded-number
+        domain of :func:`_decode_values` for that parent dim."""
+        hp = hp_by_name[parent_name]
+        if isinstance(hp, (CategoricalHyperparameter, OrdinalHyperparameter)):
+            return float(hp.index(value))  # compare by choice index
+        if isinstance(hp, Constant):
+            return 0.0 if value == hp.value else float("nan")  # never equal
+        return float(value)
+
+    def ordinal_order_value(parent_name: str, value) -> float:
+        """Greater/Less on an ordinal compares VALUES host-side; on device
+        we compare indices, which is order-faithful only if the sequence is
+        numerically sorted."""
+        hp = hp_by_name[parent_name]
+        seq = hp.sequence
+        try:
+            numeric = [float(v) for v in seq]
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"device conditions need a numeric ordinal sequence for "
+                f"order comparisons on {parent_name!r}"
+            )
+        if numeric != sorted(numeric):
+            raise ValueError(
+                f"ordinal {parent_name!r} is not numerically sorted; order "
+                f"comparisons have no index representation"
+            )
+        return float(hp.index(value))
+
+    def compile_cond(c):
+        if isinstance(c, AndConjunction):
+            subs = [compile_cond(x) for x in c.components]
+            return lambda dec, act: jnp.all(
+                jnp.stack([f(dec, act) for f in subs])
+            )
+        if isinstance(c, OrConjunction):
+            subs = [compile_cond(x) for x in c.components]
+            return lambda dec, act: jnp.any(
+                jnp.stack([f(dec, act) for f in subs])
+            )
+        j = index[c.parent_name]
+        parent_hp = hp_by_name[c.parent_name]
+        is_ord = isinstance(parent_hp, OrdinalHyperparameter)
+        if isinstance(c, EqualsCondition):
+            v = cond_value_to_number(c.parent_name, c.value)
+            test = lambda x: x == v  # noqa: E731
+        elif isinstance(c, NotEqualsCondition):
+            v = cond_value_to_number(c.parent_name, c.value)
+            test = lambda x: x != v  # noqa: E731
+        elif isinstance(c, InCondition):
+            vals = [cond_value_to_number(c.parent_name, v) for v in c.value]
+            test = lambda x: jnp.any(  # noqa: E731
+                jnp.stack([x == v for v in vals])
+            )
+        elif isinstance(c, GreaterThanCondition):
+            v = (
+                ordinal_order_value(c.parent_name, c.value)
+                if is_ord else float(c.value)
+            )
+            test = lambda x: x > v  # noqa: E731
+        elif isinstance(c, LessThanCondition):
+            v = (
+                ordinal_order_value(c.parent_name, c.value)
+                if is_ord else float(c.value)
+            )
+            test = lambda x: x < v  # noqa: E731
+        else:
+            raise ValueError(
+                f"condition type {type(c).__name__} has no device compilation"
+            )
+        return lambda dec, act, j=j, test=test: act[j] & test(dec[j])
+
+    # per-dim compiled condition list, evaluated in topological order so a
+    # parent's activity is decided before any of its children
+    topo = configspace._topological_order()
+    per_dim = {
+        index[name]: [
+            compile_cond(c)
+            for c in configspace.get_conditions()
+            if c.child_name == name
+        ]
+        for name in topo
+    }
+
+    def mask_fn(q: jax.Array) -> jax.Array:
+        dec = _decode_values(codec, q)
+        act = jnp.ones(len(names), bool)
+        for name in topo:
+            j = index[name]
+            for fn in per_dim[j]:
+                act = act.at[j].set(act[j] & fn(dec, act))
+        return act
+
+    return mask_fn
+
+
 class SweepBracketOutput(NamedTuple):
     """Per-bracket device outputs of the fused sweep."""
 
@@ -232,6 +395,41 @@ class SweepBracketOutput(NamedTuple):
     loss_packed: jax.Array
 
 
+def _impute_conditional_device(
+    key: jax.Array, data: jax.Array, cards: jax.Array
+) -> jax.Array:
+    """Device twin of ``BOHBKDE.impute_conditional_data``: every NaN
+    (inactive-dim) entry borrows the value of a uniformly random *active*
+    row of the same column; columns with no active rows fall back to a
+    random category (discrete) or uniform draw (continuous).
+
+    O(n·d): donors are drawn by inverse-CDF over each column's running
+    active count (no n x n materialization)."""
+    n, d = data.shape
+    isnan = jnp.isnan(data)
+    active = (~isnan).astype(jnp.int32)
+    cnt = jnp.cumsum(active, axis=0)  # [n, d] running donor count
+    total = cnt[-1, :]  # [d]
+    k_pick, k_fb = jax.random.split(key)
+    u = jax.random.uniform(k_pick, (n, d))
+    # r-th donor (1-indexed) per entry; searchsorted over the column's
+    # non-decreasing count finds its row
+    r = jnp.floor(u * jnp.maximum(total, 1)[None, :]).astype(jnp.int32) + 1
+    rows = jax.vmap(
+        lambda c, rr: jnp.searchsorted(c, rr, side="left"), in_axes=(1, 1),
+        out_axes=1,
+    )(cnt, r)
+    donated = jnp.take_along_axis(data, jnp.clip(rows, 0, n - 1), axis=0)
+
+    u_fb = jax.random.uniform(k_fb, (n, d))
+    cards_f = jnp.maximum(cards.astype(jnp.float32), 1.0)
+    disc = jnp.clip(jnp.floor(u_fb * cards_f), 0, cards_f - 1)
+    fallback = jnp.where(cards[None, :] > 0, disc, u_fb)
+
+    fill = jnp.where((total > 0)[None, :], donated, fallback)
+    return jnp.where(isnan, fill, data)
+
+
 def _fit_kde_pair_device(
     vecs: jax.Array,
     losses: jax.Array,
@@ -239,14 +437,20 @@ def _fit_kde_pair_device(
     n_bad: int,
     cards: jax.Array,
     min_bandwidth: float,
+    impute_key: Optional[jax.Array] = None,
 ) -> Tuple[KDE, KDE]:
-    """Device twin of BOHBKDE._fit_kde_pair/_make_kde for imputation-free
-    (condition-free) observations: stable sort by loss, top ``n_good`` /
-    bottom ``n_bad`` rows, normal-reference bandwidths."""
+    """Device twin of BOHBKDE._fit_kde_pair/_make_kde: stable sort by loss,
+    top ``n_good`` / bottom ``n_bad`` rows, normal-reference bandwidths.
+    Pass ``impute_key`` for conditional spaces — NaN (inactive) dims are
+    then donor-imputed per split side, like the host model."""
     n = vecs.shape[0]
     order = jnp.argsort(losses, stable=True)
     good = vecs[order[:n_good]]
     bad = vecs[order[n - n_bad:]]
+    if impute_key is not None:
+        kg, kb = jax.random.split(impute_key)
+        good = _impute_conditional_device(kg, good, cards)
+        bad = _impute_conditional_device(kb, bad, cards)
 
     def mk(data: jax.Array) -> KDE:
         mask = jnp.ones(data.shape[0], jnp.float32)
@@ -273,6 +477,7 @@ def make_fused_sweep_fn(
     use_pallas: bool = False,
     pallas_interpret: bool = False,
     rank_fn: Optional[Callable] = None,
+    active_mask_fn: Optional[Callable] = None,
 ) -> Callable[..., List[SweepBracketOutput]]:
     """Trace + jit the whole sweep; returns ``fn(seed[, warm_v, warm_l])``.
 
@@ -331,7 +536,9 @@ def make_fused_sweep_fn(
 
         for b_i, plan in enumerate(plans):
             n0 = plan.num_configs[0]
-            k_rand, k_prop, k_frac = jax.random.split(jax.random.fold_in(key, b_i), 3)
+            k_rand, k_prop, k_frac, k_fit = jax.random.split(
+                jax.random.fold_in(key, b_i), 4
+            )
             rand_vecs = random_unit(codec, k_rand, n0)
 
             model_budget = None
@@ -349,6 +556,7 @@ def make_fused_sweep_fn(
                 good, bad = _fit_kde_pair_device(
                     obs_v[model_budget][:n], obs_l[model_budget][:n],
                     n_good, n_bad, cards_dev, min_bandwidth,
+                    impute_key=k_fit if active_mask_fn is not None else None,
                 )
                 if use_pallas:
                     from hpbandster_tpu.ops.pallas_kde import pallas_propose_batch
@@ -370,15 +578,26 @@ def make_fused_sweep_fn(
                 proposals = jnp.where(mb_mask[:, None], model_vecs, rand_vecs)
 
             vectors = quantize_unit(codec, proposals)
+            if active_mask_fn is not None:
+                # conditional space: evaluation sees 0 in inactive dims
+                # (host parity: to_vector -> NaN -> nan_to_num(0)), while
+                # observations and outputs carry NaN so the host decoder
+                # and the KDE imputation see the true activity pattern
+                active = jax.vmap(active_mask_fn)(vectors)
+                eval_vectors = jnp.where(active, vectors, 0.0)
+                out_vectors = jnp.where(active, vectors, jnp.nan)
+            else:
+                eval_vectors = out_vectors = vectors
             if mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec
 
-                vectors = jax.lax.with_sharding_constraint(
-                    vectors, NamedSharding(mesh, PartitionSpec(axis))
+                eval_vectors = jax.lax.with_sharding_constraint(
+                    eval_vectors, NamedSharding(mesh, PartitionSpec(axis))
                 )
 
             stages = fused_sh_bracket(
-                eval_fn, vectors, plan.num_configs, plan.budgets, rank_fn=rank_fn
+                eval_fn, eval_vectors, plan.num_configs, plan.budgets,
+                rank_fn=rank_fn,
             )
 
             for (idx_s, losses_s), k_s, budget in zip(
@@ -386,7 +605,7 @@ def make_fused_sweep_fn(
             ):
                 b = float(budget)
                 c = counts[b]
-                obs_v[b] = obs_v[b].at[c:c + k_s].set(vectors[idx_s])
+                obs_v[b] = obs_v[b].at[c:c + k_s].set(out_vectors[idx_s])
                 obs_l[b] = obs_l[b].at[c:c + k_s].set(
                     jnp.where(jnp.isnan(losses_s), jnp.inf, losses_s)
                 )
@@ -394,7 +613,9 @@ def make_fused_sweep_fn(
 
             idx_packed, loss_packed = _pack_stages(stages)
             outputs.append(
-                SweepBracketOutput(vectors[:n0], mb_mask, idx_packed, loss_packed)
+                SweepBracketOutput(
+                    out_vectors[:n0], mb_mask, idx_packed, loss_packed
+                )
             )
         return outputs
 

@@ -89,7 +89,20 @@ class FusedBOHB:
                 "FusedBOHB needs a jittable eval_fn(config_vector, budget) -> loss"
             )
         self.configspace = configspace
-        self.codec = build_space_codec(configspace)  # raises on conditional spaces
+        self.codec = build_space_codec(configspace)  # raises on forbiddens
+        # conditional spaces: the condition DAG compiles to an on-device
+        # activity mask (ops.sweep.compile_active_mask); raises for
+        # condition forms without a device representation
+        if configspace.get_conditions():
+            from hpbandster_tpu.ops.sweep import compile_active_mask
+
+            self.active_mask_fn = compile_active_mask(configspace, self.codec)
+            self._conditions_sig = tuple(
+                repr(c) for c in configspace.get_conditions()
+            )
+        else:
+            self.active_mask_fn = None
+            self._conditions_sig = ()
         self.eval_fn = eval_fn
         self.run_id = run_id
         self.eta = float(eta)
@@ -166,9 +179,11 @@ class FusedBOHB:
         id2conf = previous_result.get_id2config_mapping()
         for run in previous_result.get_all_runs(only_largest_budget=False):
             cfg = id2conf[run.config_id]["config"]
-            vec = np.nan_to_num(
-                self.configspace.to_vector(cfg), nan=0.0
-            ).astype(np.float32)
+            vec = self.configspace.to_vector(cfg).astype(np.float32)
+            if self.active_mask_fn is None:
+                # condition-free: the device fit does not impute, so NaNs
+                # (from foreign results) must not reach it
+                vec = np.nan_to_num(vec, nan=0.0)
             b = float(run.budget)
             # crashed (None) losses register as maximally bad, like
             # BOHBKDE.new_result
@@ -213,6 +228,7 @@ class FusedBOHB:
             self.use_pallas,
             self.pallas_interpret,
             self.promotion_rank_fn,
+            self._conditions_sig,
         )
         fn = _SWEEP_FN_CACHE.get(key)
         if fn is None:
@@ -232,6 +248,7 @@ class FusedBOHB:
                 use_pallas=self.use_pallas,
                 pallas_interpret=self.pallas_interpret,
                 rank_fn=self.promotion_rank_fn,
+                active_mask_fn=self.active_mask_fn,
             )
             _SWEEP_FN_CACHE[key] = fn
         return fn
