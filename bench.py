@@ -1,27 +1,41 @@
 #!/usr/bin/env python
-"""Benchmark: configs evaluated per second per chip.
+"""Benchmark: configs evaluated per second per chip, all execution tiers.
 
 Workload: BASELINE.json config #1 — BOHB on the 2-D Branin toy, eta=3,
-budget ladder 1..81 — run two ways on the same machine:
+budget ladder 1..81 — measured on the same machine across the framework's
+execution tiers, fastest last:
 
-* **fused TPU path** (this framework's north star): the ENTIRE multi-bracket
-  sweep — KDE proposals, evaluations, top-k promotions, model refits — is
-  one compiled device program (``ops/sweep.py``); a run is one dispatch
-  plus one result fetch.
-* **reference-architecture path**: the same optimizer driven through the
-  nameserver/dispatcher/worker pool, strictly one config per worker per TCP
-  RPC round-trip — the reference's throughput ceiling
-  (``n_workers / mean_job_seconds``, BASELINE.md).
+* **RPC pool** (reference architecture): nameserver/dispatcher/worker,
+  strictly one config per worker per TCP RPC round-trip — the reference's
+  throughput ceiling (``n_workers / mean_job_seconds``, BASELINE.md).
+* **Per-bracket batched**: ``BOHB + BatchedExecutor(VmapBackend)`` with
+  ``parallel_brackets=3`` pipelining — each stage is one device dispatch.
+* **Fused whole-sweep** (north star): the ENTIRE multi-bracket sweep —
+  KDE proposals, evaluations, top-k promotions, model refits — is one
+  compiled device program (``ops/sweep.py``).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Also measured: the fused sweep at 10k-config scale (36 brackets, 1..729)
+and a CNN training workload (budget = SGD steps).
+
+Methodology (VERDICT r1 "weak #5"): the tunneled-chip link adds multi-x
+run-to-run variance, so the headline is the MEDIAN of 5 paired runs with
+the IQR persisted alongside; every tier's raw runs are in the JSON so
+BASELINE.md's table regenerates from artifacts, not prose
+(``python bench.py --write-baseline``).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 """
 
 import json
 import logging
+import statistics
+import sys
 import time
 
 logging.getLogger().setLevel(logging.ERROR)
 logging.disable(logging.WARNING)
+
+HEADLINE_BRACKETS = 27
 
 
 def _enable_persistent_compile_cache():
@@ -40,24 +54,40 @@ def _enable_persistent_compile_cache():
         pass  # older jax: flag names differ; warm in-process caches still apply
 
 
-def bench_batched(n_iterations: int, seed: int = 0):
-    """Fused whole-sweep path: the entire multi-bracket BOHB run (proposals,
-    KDE fits, evaluations, promotions) is ONE compiled device program
-    (``ops/sweep.py``) — one dispatch + one result fetch per run."""
+def _summary(rates):
+    """Median + IQR of per-run rates. Callers must pass >= 3 runs — with
+    fewer, a [min, max] spread would masquerade as an IQR."""
+    assert len(rates) >= 3, "need >= 3 runs for an honest IQR"
+    rates = sorted(rates)
+    q = statistics.quantiles(rates, n=4)
+    return {
+        "median": round(statistics.median(rates), 2),
+        "iqr": [round(q[0], 2), round(q[2], 2)],
+        "runs_configs_per_s": [round(r, 2) for r in rates],
+    }
+
+
+def _mesh_or_none():
     import jax
 
-    from hpbandster_tpu.optimizers import FusedBOHB
     from hpbandster_tpu.parallel import config_mesh
-    from hpbandster_tpu.workloads.toys import branin_from_vector, branin_space
 
     devices = jax.devices()
-    mesh = config_mesh(devices) if len(devices) > 1 else None
+    return (config_mesh(devices) if len(devices) > 1 else None), len(devices)
+
+
+def bench_fused(n_iterations, repeats=5, max_budget=81, seed=0):
+    """Fused whole-sweep path; returns per-run configs/s plus eval counts."""
+    from hpbandster_tpu.optimizers import FusedBOHB
+    from hpbandster_tpu.workloads.toys import branin_from_vector, branin_space
+
+    mesh, _ = _mesh_or_none()
 
     def run(n_iter, seed):
         cs = branin_space(seed=seed)
         opt = FusedBOHB(
             configspace=cs, eval_fn=branin_from_vector, run_id=f"bench-{seed}",
-            min_budget=1, max_budget=81, eta=3, seed=seed, mesh=mesh,
+            min_budget=1, max_budget=max_budget, eta=3, seed=seed, mesh=mesh,
         )
         t0 = time.perf_counter()
         opt.run(n_iterations=n_iter)
@@ -65,14 +95,48 @@ def bench_batched(n_iterations: int, seed: int = 0):
         opt.shutdown()
         return opt.total_evaluated, dt
 
-    run(n_iterations, seed=99)  # warmup: populate jit caches (compile time excluded)
-    # best of 3: the tunneled-chip link adds multi-x run-to-run variance
-    results = [run(n_iterations, seed + i) for i in range(3)]
-    n_evals, dt = min(results, key=lambda r: r[1] / r[0])
-    return n_evals, dt, len(devices)
+    run(n_iterations, seed=99)  # warmup: populate jit caches (compile excluded)
+    rates, n_evals = [], 0
+    for i in range(repeats):
+        n, dt = run(n_iterations, seed + i)
+        rates.append(n / dt)
+        n_evals = n
+    return rates, n_evals
 
 
-def bench_rpc_baseline(n_iterations: int = 1, n_workers: int = 1, seed: int = 0):
+def bench_batched(n_iterations=5, repeats=3, seed=0):
+    """Per-bracket batched tier: BatchedExecutor + VmapBackend, pb=3."""
+    from hpbandster_tpu.optimizers import BOHB
+    from hpbandster_tpu.parallel import BatchedExecutor, VmapBackend
+    from hpbandster_tpu.workloads.toys import branin_from_vector, branin_space
+
+    mesh, _ = _mesh_or_none()
+
+    def run(seed):
+        cs = branin_space(seed=seed)
+        executor = BatchedExecutor(
+            VmapBackend(branin_from_vector, mesh=mesh), cs, parallel_brackets=3
+        )
+        opt = BOHB(
+            configspace=cs, run_id=f"bench-b{seed}", executor=executor,
+            min_budget=1, max_budget=81, eta=3, seed=seed,
+        )
+        t0 = time.perf_counter()
+        res = opt.run(n_iterations=n_iterations)
+        dt = time.perf_counter() - t0
+        n = len([r for r in res.get_all_runs() if r.loss is not None])
+        opt.shutdown()
+        return n, dt
+
+    run(seed=99)  # warmup
+    rates = []
+    for i in range(repeats):
+        n, dt = run(seed + i)
+        rates.append(n / dt)
+    return rates
+
+
+def bench_rpc_baseline(n_iterations=1, n_workers=1, repeats=3, seed=0):
     """Reference-architecture throughput on this host: one config per RPC."""
     from hpbandster_tpu.core.nameserver import NameServer
     from hpbandster_tpu.core.worker import Worker
@@ -83,45 +147,171 @@ def bench_rpc_baseline(n_iterations: int = 1, n_workers: int = 1, seed: int = 0)
         def compute(self, config_id, config, budget, working_directory):
             return {"loss": branin_dict(config, budget), "info": {}}
 
-    ns = NameServer(run_id="bench-rpc", host="127.0.0.1", port=0)
-    host, port = ns.start()
-    for i in range(n_workers):
-        BraninWorker(
-            run_id="bench-rpc", nameserver=host, nameserver_port=port, id=i
-        ).run(background=True)
-    opt = BOHB(
-        configspace=branin_space(seed=seed), run_id="bench-rpc",
-        nameserver=host, nameserver_port=port,
-        min_budget=1, max_budget=81, eta=3, seed=seed,
+    rates = []
+    for i in range(repeats):
+        ns = NameServer(run_id=f"bench-rpc{i}", host="127.0.0.1", port=0)
+        host, port = ns.start()
+        for w in range(n_workers):
+            BraninWorker(
+                run_id=f"bench-rpc{i}", nameserver=host, nameserver_port=port, id=w
+            ).run(background=True)
+        opt = BOHB(
+            configspace=branin_space(seed=seed + i), run_id=f"bench-rpc{i}",
+            nameserver=host, nameserver_port=port,
+            min_budget=1, max_budget=81, eta=3, seed=seed + i,
+        )
+        t0 = time.perf_counter()
+        res = opt.run(n_iterations=n_iterations, min_n_workers=n_workers)
+        dt = time.perf_counter() - t0
+        n = len(res.get_all_runs())
+        opt.shutdown(shutdown_workers=True)
+        ns.shutdown()
+        rates.append(n / dt)
+    return rates
+
+
+def bench_cnn(seed=0):
+    """CNN training workload: budget = SGD steps on procedural images."""
+    from hpbandster_tpu.optimizers import FusedBOHB
+    from hpbandster_tpu.workloads.cnn import CNNConfig, cnn_space, make_cnn_eval_fn
+
+    mesh, _ = _mesh_or_none()
+    cs = cnn_space(seed=seed)
+    opt = FusedBOHB(
+        configspace=cs, eval_fn=make_cnn_eval_fn(CNNConfig(), data_seed=0),
+        run_id="bench-cnn", min_budget=3, max_budget=81, eta=3, seed=seed,
+        mesh=mesh,
     )
     t0 = time.perf_counter()
-    res = opt.run(n_iterations=n_iterations, min_n_workers=n_workers)
+    res = opt.run(n_iterations=5)
     dt = time.perf_counter() - t0
-    n = len(res.get_all_runs())
-    opt.shutdown(shutdown_workers=True)
-    ns.shutdown()
-    return n, dt
+    n = opt.total_evaluated
+    losses = [r.loss for r in res.get_all_runs() if r.loss is not None]
+    inc_id = res.get_incumbent_id()
+    inc_loss = min(
+        r.loss
+        for r in res.get_all_runs()
+        if r.config_id == inc_id and r.loss is not None
+    )
+    opt.shutdown()
+    import math
+
+    # diverging configs (aggressive lr draws) are EXPECTED in an HPO sweep;
+    # the framework masks them as crashed — report the count, and require
+    # only that the incumbent itself converged
+    n_crashed = sum(1 for l in losses if not math.isfinite(l))
+    return {
+        "evaluations": n,
+        "seconds_incl_compile": round(dt, 2),
+        "configs_per_s": round(n / dt, 2),
+        "crashed_configs_masked": n_crashed,
+        "incumbent_loss": round(float(inc_loss), 4),
+        "incumbent_converged": bool(math.isfinite(inc_loss) and inc_loss < 1.0),
+    }
+
+
+def collect():
+    import jax
+
+    _enable_persistent_compile_cache()
+    devices = jax.devices()
+    n_chips = len(devices)
+
+    fused_rates, _ = bench_fused(HEADLINE_BRACKETS, repeats=5)
+    fused = _summary([r / n_chips for r in fused_rates])
+    fused10k_rates, n10k = bench_fused(36, repeats=3, max_budget=729, seed=50)
+    fused10k = _summary([r / n_chips for r in fused10k_rates])
+    fused10k["total_configs_per_run"] = n10k
+    batched = _summary([r / n_chips for r in bench_batched()])
+    rpc = _summary(bench_rpc_baseline())
+    cnn = bench_cnn()
+
+    value = fused["median"]
+    return {
+        "metric": "configs evaluated/sec/chip (BOHB, Branin, eta=3, budgets 1..81)",
+        "value": value,
+        "unit": "configs/s/chip",
+        "vs_baseline": round(value / rpc["median"], 2),
+        "detail": {
+            "method": (
+                "median of N paired same-process runs per tier (IQR alongside); "
+                "vs_baseline = fused median / same-machine RPC median"
+            ),
+            "chip": str(devices[0].device_kind),
+            "platform": str(devices[0].platform),
+            "n_chips": n_chips,
+            "tiers": {
+                "rpc_pool_1worker": rpc,
+                "batched_parallel_brackets3": batched,
+                "fused_27_brackets": fused,
+                "fused_10k_scale_36_brackets_1_729": fused10k,
+            },
+            "cnn_workload_budget_sgd_steps": cnn,
+        },
+    }
+
+
+BASELINE_MARK = "## Measured (this rebuild"
+
+
+def write_baseline(result, path="BASELINE.md"):
+    """Regenerate BASELINE.md's measured table from the bench JSON."""
+    t = result["detail"]["tiers"]
+
+    def row(name, s):
+        lo, hi = s["iqr"]
+        return f"| {name} | {s['median']} | [{lo}, {hi}] |"
+
+    cnn = result["detail"]["cnn_workload_budget_sgd_steps"]
+    lines = [
+        BASELINE_MARK + ", one real TPU chip via tunnel)",
+        "",
+        "All numbers are configs/s/chip, **median of paired same-process runs "
+        "with interquartile range** (the tunnel link adds multi-x variance; "
+        "see `bench.py`). Chip: `%s` (%s ×%d). Regenerate with "
+        "`python bench.py --write-baseline`."
+        % (
+            result["detail"]["chip"],
+            result["detail"]["platform"],
+            result["detail"]["n_chips"],
+        ),
+        "",
+        "| Path | configs/s/chip (median) | IQR |",
+        "|---|---|---|",
+        row("Host RPC pool (reference architecture, 1 worker)", t["rpc_pool_1worker"]),
+        row("Per-bracket batched (+3-bracket pipelining)", t["batched_parallel_brackets3"]),
+        row("Fused whole-sweep (`FusedBOHB`, 27 brackets)", t["fused_27_brackets"]),
+        row("Fused at 10k-config scale (36 brackets, 1..729)", t["fused_10k_scale_36_brackets_1_729"]),
+        "",
+        "Headline vs same-machine RPC baseline: **%.0f×**." % result["vs_baseline"],
+        "",
+        "CNN training workload (budget = SGD steps, 5 brackets 3..81): "
+        "%d evaluations in %.1f s including the one-time compile "
+        "(%.1f configs/s); %d diverging config(s) masked as crashed; "
+        "incumbent loss %.3f (converged: %s)."
+        % (
+            cnn["evaluations"],
+            cnn["seconds_incl_compile"],
+            cnn["configs_per_s"],
+            cnn["crashed_configs_masked"],
+            cnn["incumbent_loss"],
+            cnn["incumbent_converged"],
+        ),
+        "",
+    ]
+    with open(path) as f:
+        text = f.read()
+    cut = text.find(BASELINE_MARK)
+    text = text[:cut] if cut >= 0 else text + "\n"
+    with open(path, "w") as f:
+        f.write(text + "\n".join(lines))
 
 
 def main():
-    _enable_persistent_compile_cache()
-    # the BASELINE.json headline configuration: 27 brackets, eta=3, 1..81
-    n_evals, dt, n_chips = bench_batched(n_iterations=27)
-    batched_cps_chip = n_evals / dt / n_chips
-
-    n_ref, dt_ref = bench_rpc_baseline(n_iterations=1, n_workers=1)
-    ref_cps = n_ref / dt_ref
-
-    print(
-        json.dumps(
-            {
-                "metric": "configs evaluated/sec/chip (BOHB, Branin, eta=3, budgets 1..81)",
-                "value": round(batched_cps_chip, 2),
-                "unit": "configs/s/chip",
-                "vs_baseline": round(batched_cps_chip / ref_cps, 2),
-            }
-        )
-    )
+    result = collect()
+    if "--write-baseline" in sys.argv:
+        write_baseline(result)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

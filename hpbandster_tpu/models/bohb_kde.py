@@ -196,8 +196,10 @@ class BOHBKDE(base_config_generator):
         mask = np.zeros(cap, np.float32)
         mask[:n] = 1.0
         # normal-reference rule, numpy mirror of ops.normal_reference_bandwidths
+        # (statsmodels hardcodes C=1.06, NOT the theoretical 1.05922 — see
+        # the derivation note on normal_reference_bandwidths)
         sigma = data.std(axis=0)
-        bw = 1.059 * sigma * n ** (-1.0 / (4.0 + d))
+        bw = 1.06 * sigma * n ** (-1.0 / (4.0 + d))
         cards = np.asarray(self.cards, np.float64)
         cap_discrete = np.where(
             cards > 0, (np.maximum(cards, 2) - 1.0) / np.maximum(cards, 2), np.inf

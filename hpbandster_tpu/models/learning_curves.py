@@ -12,6 +12,7 @@ small and fits run host-side between stages.
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
@@ -46,8 +47,10 @@ class PowerLawModel:
     the identical quantity — passing a tinier floor cannot tighten it.
     """
 
-    def __init__(self, floor: float = 1e-6):
-        self.floor = floor
+    def __init__(self, floor: float = None):
+        self._user_floor = floor is not None
+        self.floor = 1e-6 if floor is None else float(floor)
+        self._warned_floor_override = False
 
     def fit(self, curves: List[Curve]) -> "PowerLawModel":
         return self
@@ -67,6 +70,18 @@ class PowerLawModel:
         # scale-aware floor so the device (f32) twin in ops.bracket can
         # represent the same offset: ymin - 1e-12 is a no-op in f32
         floor = max(self.floor, abs(y.min()) * 1e-5)
+        # only a USER-chosen floor being overridden merits a warning — the
+        # default floor is below the scale bound on every ordinary loss scale
+        if (
+            self._user_floor
+            and floor > self.floor
+            and not self._warned_floor_override
+        ):
+            self._warned_floor_override = True
+            logging.getLogger("hpbandster_tpu.learning_curves").warning(
+                "PowerLawModel floor %.3g raised to scale-aware bound %.3g "
+                "(|ymin|*1e-5) for f32 device parity", self.floor, floor
+            )
         c = min(c_est, y.min() - floor) if np.isfinite(c_est) else y.min() - floor
         resid = y - c
         if (resid <= 0).any() or (np.diff(y) > 0).all():

@@ -37,7 +37,8 @@ from hpbandster_tpu.ops.fused import fused_sh_bracket, _pack_stages
 from hpbandster_tpu.ops.kde import KDE, normal_reference_bandwidths, propose
 
 __all__ = ["SpaceCodec", "build_space_codec", "quantize_unit", "random_unit",
-           "compile_active_mask", "make_fused_sweep_fn", "SweepBracketOutput"]
+           "compile_active_mask", "compile_forbidden_mask",
+           "make_fused_sweep_fn", "SweepBracketOutput"]
 
 
 class SpaceCodec(NamedTuple):
@@ -69,9 +70,9 @@ class SpaceCodec(NamedTuple):
 
 
 def build_space_codec(configspace) -> SpaceCodec:
-    """Extract the static codec; raises ``ValueError`` for spaces the fused
-    sweep cannot represent (forbidden clauses; conditions are supported via
-    :func:`compile_active_mask`)."""
+    """Extract the static codec. Conditions are supported on-device via
+    :func:`compile_active_mask`, forbiddens via :func:`compile_forbidden_mask`
+    + in-trace rejection resampling (``make_fused_sweep_fn``)."""
     from hpbandster_tpu.space.hyperparameters import (
         CategoricalHyperparameter,
         Constant,
@@ -80,11 +81,6 @@ def build_space_codec(configspace) -> SpaceCodec:
         UniformIntegerHyperparameter,
     )
 
-    if configspace.get_forbiddens():
-        raise ValueError(
-            "fused sweep supports forbidden-free spaces; "
-            "use the per-bracket batched path for forbidden clauses"
-        )
     hps = configspace.get_hyperparameters()
     d = len(hps)
     kind = np.zeros(d, np.int32)
@@ -340,18 +336,24 @@ def compile_active_mask(configspace, codec: SpaceCodec):
             test = lambda x: jnp.any(  # noqa: E731
                 jnp.stack([x == v for v in vals])
             )
-        elif isinstance(c, GreaterThanCondition):
+        elif isinstance(c, (GreaterThanCondition, LessThanCondition)):
+            # the decoded number for a categorical dim is its choice INDEX;
+            # comparing float(c.value) against an index would silently build
+            # a wrong activity mask (host compares raw values) — no device
+            # representation, so reject and let callers fall back.
+            if isinstance(parent_hp, CategoricalHyperparameter):
+                raise ValueError(
+                    f"order condition on categorical parent "
+                    f"{c.parent_name!r} has no device representation"
+                )
             v = (
                 ordinal_order_value(c.parent_name, c.value)
                 if is_ord else float(c.value)
             )
-            test = lambda x: x > v  # noqa: E731
-        elif isinstance(c, LessThanCondition):
-            v = (
-                ordinal_order_value(c.parent_name, c.value)
-                if is_ord else float(c.value)
-            )
-            test = lambda x: x < v  # noqa: E731
+            if isinstance(c, GreaterThanCondition):
+                test = lambda x, v=v: x > v  # noqa: E731
+            else:
+                test = lambda x, v=v: x < v  # noqa: E731
         else:
             raise ValueError(
                 f"condition type {type(c).__name__} has no device compilation"
@@ -380,6 +382,93 @@ def compile_active_mask(configspace, codec: SpaceCodec):
         return act
 
     return mask_fn
+
+
+def compile_forbidden_mask(configspace, codec: SpaceCodec):
+    """Compile the space's forbidden clauses to a jittable predicate.
+
+    Returns ``forbidden_fn(q: f32[d], act: bool[d]) -> bool[]`` — True when
+    the QUANTIZED vector violates any forbidden clause — the device twin of
+    ``ConfigurationSpace.is_forbidden``. A clause term on an inactive dim is
+    False (host parity: ``is_forbidden`` only sees active values). Equality
+    on a continuous dim uses a 1e-6 relative tolerance (the f32 decode
+    cannot reproduce host float64 values exactly; host equality on a
+    continuous draw is measure-zero anyway); discrete dims compare their
+    choice indices exactly. Raises ``ValueError`` for clause types without
+    a device compilation — callers fall back to the per-bracket path.
+    """
+    from hpbandster_tpu.space.forbidden import (
+        ForbiddenAndConjunction,
+        ForbiddenEqualsClause,
+        ForbiddenInClause,
+    )
+    from hpbandster_tpu.space.hyperparameters import (
+        CategoricalHyperparameter,
+        Constant,
+        OrdinalHyperparameter,
+    )
+
+    names = configspace.get_hyperparameter_names()
+    index = {n: i for i, n in enumerate(names)}
+    hp_by_name = dict(zip(names, configspace.get_hyperparameters()))
+
+    def value_to_number(name: str, value) -> float:
+        hp = hp_by_name[name]
+        if isinstance(hp, (CategoricalHyperparameter, OrdinalHyperparameter)):
+            return float(hp.index(value))
+        if isinstance(hp, Constant):
+            return 0.0 if value == hp.value else float("nan")  # never equal
+        return float(value)
+
+    def eq_term(name: str, value):
+        if name not in index:
+            raise ValueError(f"forbidden clause on unknown parameter {name!r}")
+        j = index[name]
+        v = value_to_number(name, value)
+        if int(codec.kind[j]) == 0:  # continuous: f32-tolerant equality
+            # tolerance must track the f32 DECODE error model per scale
+            # kind: a linear decode (lo + u*(hi-lo)) has absolute error
+            # ~ulps of max(|lo|,|hi|,range); a log decode (exp of a lerp in
+            # log space) has error RELATIVE to the decoded value. A single
+            # absolute tolerance would either let forbidden configs slip
+            # through on wide linear ranges or over-forbid log dims near
+            # small clause values. 1e-5 ≈ 80 f32 ulps of headroom.
+            lo, hi = float(codec.lower[j]), float(codec.upper[j])
+            if bool(codec.log[j]):
+                tol = 1e-5 * max(abs(v), 1e-30)
+            else:
+                tol = 1e-5 * max(hi - lo, abs(lo), abs(hi))
+            return lambda dec, act, j=j, v=v, tol=tol: act[j] & (
+                jnp.abs(dec[j] - v) <= tol
+            )
+        return lambda dec, act, j=j, v=v: act[j] & (dec[j] == v)
+
+    def compile_clause(c):
+        if isinstance(c, ForbiddenAndConjunction):
+            subs = [compile_clause(x) for x in c.components]
+            return lambda dec, act: jnp.all(
+                jnp.stack([f(dec, act) for f in subs])
+            )
+        if isinstance(c, ForbiddenEqualsClause):
+            return eq_term(c.name, c.value)
+        if isinstance(c, ForbiddenInClause):
+            terms = [eq_term(c.name, v) for v in c.values]
+            return lambda dec, act: jnp.any(
+                jnp.stack([f(dec, act) for f in terms])
+            )
+        raise ValueError(
+            f"forbidden clause type {type(c).__name__} has no device compilation"
+        )
+
+    clauses = [compile_clause(c) for c in configspace.get_forbiddens()]
+
+    def forbidden_fn(q: jax.Array, act: jax.Array) -> jax.Array:
+        if not clauses:
+            return jnp.zeros((), bool)
+        dec = _decode_values(codec, q)
+        return jnp.any(jnp.stack([f(dec, act) for f in clauses]))
+
+    return forbidden_fn
 
 
 class SweepBracketOutput(NamedTuple):
@@ -478,6 +567,9 @@ def make_fused_sweep_fn(
     pallas_interpret: bool = False,
     rank_fn: Optional[Callable] = None,
     active_mask_fn: Optional[Callable] = None,
+    forbidden_fn: Optional[Callable] = None,
+    fallback_vector: Optional[np.ndarray] = None,
+    max_forbidden_retries: int = 8,
 ) -> Callable[..., List[SweepBracketOutput]]:
     """Trace + jit the whole sweep; returns ``fn(seed[, warm_v, warm_l])``.
 
@@ -492,8 +584,17 @@ def make_fused_sweep_fn(
     ``warm_l`` (budget -> f32[n]) whose leaves seed the observation buffers
     — traced inputs, so re-warming with fresh data of the same shape reuses
     the compiled program.
+
+    ``forbidden_fn`` (from :func:`compile_forbidden_mask`) enables forbidden
+    clauses on-device by rejection resampling INSIDE the trace: each
+    bracket's proposals are checked, violating rows are redrawn uniformly up
+    to ``max_forbidden_retries`` times, and any row still forbidden after
+    that is replaced by ``fallback_vector`` (a host-verified valid
+    configuration) — bounded work, static shapes, no host round-trip.
     """
     d = int(codec.kind.shape[0])
+    if forbidden_fn is not None and fallback_vector is None:
+        raise ValueError("forbidden_fn requires a fallback_vector")
     min_pts = (d + 1) if min_points_in_model is None else max(int(min_points_in_model), d + 1)
     plans = [BracketPlan(tuple(p.num_configs), tuple(p.budgets)) for p in plans]
     warm_counts = {float(b): int(n) for b, n in (warm_counts or {}).items() if n > 0}
@@ -578,6 +679,44 @@ def make_fused_sweep_fn(
                 proposals = jnp.where(mb_mask[:, None], model_vecs, rand_vecs)
 
             vectors = quantize_unit(codec, proposals)
+
+            if forbidden_fn is not None:
+                # in-trace rejection resampling (bounded, static shapes):
+                # redraw forbidden rows uniformly; anything still forbidden
+                # after the retry budget clamps to the known-valid fallback
+                def batch_act(vecs):
+                    if active_mask_fn is not None:
+                        return jax.vmap(active_mask_fn)(vecs)
+                    return jnp.ones(vecs.shape, bool)
+
+                k_forb = jax.random.fold_in(k_rand, 0x7FB)
+                resampled = jnp.zeros(n0, bool)
+                for t in range(max_forbidden_retries):
+                    forbidden_rows = jax.vmap(forbidden_fn)(
+                        vectors, batch_act(vectors)
+                    )
+                    resampled = resampled | forbidden_rows
+                    fresh = quantize_unit(
+                        codec,
+                        random_unit(codec, jax.random.fold_in(k_forb, t), n0),
+                    )
+                    vectors = jnp.where(
+                        forbidden_rows[:, None], fresh, vectors
+                    )
+                forbidden_rows = jax.vmap(forbidden_fn)(
+                    vectors, batch_act(vectors)
+                )
+                fb = quantize_unit(
+                    codec, jnp.asarray(fallback_vector, jnp.float32)
+                )
+                vectors = jnp.where(
+                    forbidden_rows[:, None], fb[None, :], vectors
+                )
+                # a redrawn/clamped row is uniform (or the fallback), not a
+                # model pick — don't let it masquerade as model-based in
+                # config_info / analysis
+                mb_mask = mb_mask & ~resampled
+
             if active_mask_fn is not None:
                 # conditional space: evaluation sees 0 in inactive dims
                 # (host parity: to_vector -> NaN -> nan_to_num(0)), while

@@ -89,7 +89,7 @@ class FusedBOHB:
                 "FusedBOHB needs a jittable eval_fn(config_vector, budget) -> loss"
             )
         self.configspace = configspace
-        self.codec = build_space_codec(configspace)  # raises on forbiddens
+        self.codec = build_space_codec(configspace)
         # conditional spaces: the condition DAG compiles to an on-device
         # activity mask (ops.sweep.compile_active_mask); raises for
         # condition forms without a device representation
@@ -103,6 +103,30 @@ class FusedBOHB:
         else:
             self.active_mask_fn = None
             self._conditions_sig = ()
+        # forbidden clauses: compiled predicate + in-trace rejection
+        # resampling; the clamp fallback is a host-verified valid config
+        if configspace.get_forbiddens():
+            from hpbandster_tpu.ops.sweep import compile_forbidden_mask
+
+            self.forbidden_fn = compile_forbidden_mask(configspace, self.codec)
+            # deterministic in the optimizer seed (not the space's shared
+            # RNG), so the clamp result is reproducible run to run
+            fb_rng = np.random.default_rng(
+                0xFB if seed is None else (int(seed) ^ 0xFB)
+            )
+            fb = configspace.to_vector(
+                configspace.sample_configuration(rng=fb_rng)
+            )
+            self._fallback_vector = np.nan_to_num(
+                np.asarray(fb, np.float32), nan=0.0
+            )
+            self._forbiddens_sig = tuple(
+                repr(c) for c in configspace.get_forbiddens()
+            ) + (self._fallback_vector.tobytes(),)
+        else:
+            self.forbidden_fn = None
+            self._fallback_vector = None
+            self._forbiddens_sig = ()
         self.eval_fn = eval_fn
         self.run_id = run_id
         self.eta = float(eta)
@@ -229,6 +253,7 @@ class FusedBOHB:
             self.pallas_interpret,
             self.promotion_rank_fn,
             self._conditions_sig,
+            self._forbiddens_sig,
         )
         fn = _SWEEP_FN_CACHE.get(key)
         if fn is None:
@@ -249,6 +274,8 @@ class FusedBOHB:
                 pallas_interpret=self.pallas_interpret,
                 rank_fn=self.promotion_rank_fn,
                 active_mask_fn=self.active_mask_fn,
+                forbidden_fn=self.forbidden_fn,
+                fallback_vector=self._fallback_vector,
             )
             _SWEEP_FN_CACHE[key] = fn
         return fn

@@ -7,6 +7,12 @@ standard BOHB bracket arithmetic and KDE proposals, but stage promotion
 ranks configs by a learning-curve *extrapolation* of their loss to the
 bracket's final budget instead of the raw current-stage loss — configs
 whose curves are still improving fast get credit for it.
+
+.. note:: behavior change vs the round-1 host model: ``PowerLawModel``'s
+   asymptote-clamp floor default moved ``1e-12 → 1e-6`` and the effective
+   offset is the scale-aware ``max(floor, |ymin| * 1e-5)``, so host and f32
+   device extrapolations agree on small-loss-scale problems. A
+   user-supplied tighter floor is raised to that bound (logged once).
 """
 
 from __future__ import annotations

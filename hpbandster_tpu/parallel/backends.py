@@ -69,6 +69,16 @@ class VmapBackend:
             return int(np.prod(list(self.mesh.shape.values())))
         return 1
 
+    @property
+    def _multiprocess(self) -> bool:
+        """True when the mesh spans more than one JAX process (DCN tier)."""
+        if self.mesh is None:
+            return False
+        return any(
+            d.process_index != jax.process_index()
+            for d in self.mesh.devices.flat
+        )
+
     def _padded_size(self, n: int) -> int:
         size = self.min_pad
         while size < n:
@@ -90,10 +100,15 @@ class VmapBackend:
         if self.mesh is not None:
             shard = NamedSharding(self.mesh, PartitionSpec(self.axis))
             rep = NamedSharding(self.mesh, PartitionSpec())
+            # DCN tier: the SPMD host driver on EVERY process needs the full
+            # loss vector for promotion decisions, so replicate the output
+            # (XLA inserts the all-gather; losses are tiny) — a sharded
+            # output would not be addressable outside its home process
+            out = rep if self._multiprocess else shard
             return jax.jit(
                 batch_fn,
                 in_shardings=(shard, rep),
-                out_shardings=shard,
+                out_shardings=out,
             )
         return jax.jit(batch_fn)
 
@@ -118,5 +133,15 @@ class VmapBackend:
             self._compiled[key] = fn
         padded = np.zeros((n_pad, d), np.float32)
         padded[:n] = vectors
-        losses = fn(jnp.asarray(padded), jnp.float32(budget))
+        if self._multiprocess:
+            # every process holds the identical full batch (deterministic
+            # SPMD driver); assemble the global sharded array from the
+            # local slice each shard's home process owns
+            shard = NamedSharding(self.mesh, PartitionSpec(self.axis))
+            batch = jax.make_array_from_callback(
+                (n_pad, d), shard, lambda idx: padded[idx]
+            )
+        else:
+            batch = jnp.asarray(padded)
+        losses = fn(batch, jnp.float32(budget))
         return np.asarray(losses)[:n]

@@ -202,6 +202,12 @@ class RPCBatchBackend:
         self.max_retries = int(max_retries)
         self._workers: Dict[str, _BatchWorkerProxy] = {}
         self._probed_not_batch: set = set()
+        #: names with an in-flight capability probe (don't re-probe)
+        self._probing: set = set()
+        #: name -> earliest next-probe time after a transient failure, so an
+        #: unreachable candidate doesn't get re-probed every refresh
+        self._probe_backoff: Dict[str, float] = {}
+        self.probe_backoff_s = 5.0
         self._last_refresh = 0.0
         self._lock = threading.Lock()
 
@@ -232,43 +238,55 @@ class RPCBatchBackend:
                 if name in self._workers:
                     if self._workers[name].uri != uri:
                         self._workers[name].uri = uri
-                elif name not in self._probed_not_batch:
+                elif (
+                    name not in self._probed_not_batch
+                    and name not in self._probing
+                    and now >= self._probe_backoff.get(name, 0.0)
+                ):
+                    self._probing.add(name)
                     to_probe.append((name, uri))
 
-        # probe OUTSIDE the lock and concurrently: one unreachable-but-
-        # registered candidate must not stall the wave (refresh runs on the
-        # evaluate() hot path) nor shard failure handling
+        # Probe OUTSIDE the lock, concurrently, and WITHOUT joining:
+        # refresh runs on the evaluate() hot path, so one unreachable-but-
+        # registered candidate must never stall a wave behind its 5 s
+        # connect timeout. A confirmed worker folds itself into the pool
+        # when its probe lands; wait_for_workers()'s poll loop picks it up.
         def probe(name: str, uri: str) -> None:
             try:
-                caps = RPCProxy(uri, timeout=5).call("capabilities")
-            except RPCError:
-                # a live worker without the method is definitively not
-                # batch-capable — cache the verdict
+                try:
+                    caps = RPCProxy(uri, timeout=5).call("capabilities")
+                except RPCError:
+                    # a live worker without the method is definitively not
+                    # batch-capable — cache the verdict
+                    with self._lock:
+                        self._probed_not_batch.add(name)
+                    return
+                except (CommunicationError, OSError):
+                    # transient (connect timeout, mid-restart): don't
+                    # blacklist, but back off so the stall can't recur on
+                    # every refresh tick
+                    with self._lock:
+                        self._probe_backoff[name] = (
+                            time.time() + self.probe_backoff_s
+                        )
+                    return
+                if not isinstance(caps, dict) or not caps.get("batch"):
+                    with self._lock:
+                        self._probed_not_batch.add(name)
+                    return
+                proxy = _BatchWorkerProxy(name, uri, caps.get("devices", 1))
                 with self._lock:
-                    self._probed_not_batch.add(name)
-                return
-            except (CommunicationError, OSError):
-                # transient (connect timeout, mid-restart): do NOT blacklist,
-                # retry on the next refresh
-                return
-            if not isinstance(caps, dict) or not caps.get("batch"):
+                    self._workers[name] = proxy
+                    self._probe_backoff.pop(name, None)
+                self.logger.info(
+                    "batched worker %s joined (%d devices)", name, proxy.devices
+                )
+            finally:
                 with self._lock:
-                    self._probed_not_batch.add(name)
-                return
-            proxy = _BatchWorkerProxy(name, uri, caps.get("devices", 1))
-            with self._lock:
-                self._workers[name] = proxy
-            self.logger.info(
-                "batched worker %s joined (%d devices)", name, proxy.devices
-            )
+                    self._probing.discard(name)
 
-        threads = [
-            threading.Thread(target=probe, args=c, daemon=True) for c in to_probe
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        for c in to_probe:
+            threading.Thread(target=probe, args=c, daemon=True).start()
 
     @property
     def parallelism(self) -> int:
@@ -326,6 +344,22 @@ class RPCBatchBackend:
                 workers = [
                     w for w in self._workers.values() if w.name not in failed_names
                 ]
+            if not workers:
+                # probes are async now — if one is in flight (e.g. a fresh
+                # worker replacing the crashed pool), give it a moment to
+                # land before declaring the wave dead
+                deadline = time.time() + self.probe_backoff_s
+                while time.time() < deadline:
+                    with self._lock:
+                        probing = bool(self._probing)
+                        workers = [
+                            w
+                            for w in self._workers.values()
+                            if w.name not in failed_names
+                        ]
+                    if workers or not probing:
+                        break
+                    time.sleep(0.05)
             if not workers:
                 self.logger.error("no batched workers alive; wave crashes as NaN")
                 break

@@ -1,0 +1,89 @@
+"""Child process for the DCN-tier integration test (not collected by pytest).
+
+Each of the two processes joins a jax.distributed pod on the CPU backend,
+builds a mesh over ALL pod devices, and runs the identical deterministic
+BOHB sweep through MultiHostBatchedExecutor — the SPMD-driver pattern from
+parallel/multihost.py. Promotion decisions are dumped per-process so the
+parent can assert they are bit-identical across hosts; only process 0
+attaches a result logger.
+
+Usage: python multihost_child.py <coordinator> <num_procs> <proc_id> <outdir>
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    coordinator, num_procs, proc_id, outdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    )
+
+    import jax
+
+    # sitecustomize may force a TPU-tunnel platform; pin CPU before init
+    jax.config.update("jax_platforms", "cpu")
+    from hpbandster_tpu.parallel.multihost import (
+        MultiHostBatchedExecutor,
+        initialize_multihost,
+        is_primary_host,
+    )
+
+    got_id = initialize_multihost(
+        coordinator_address=coordinator,
+        num_processes=num_procs,
+        process_id=proc_id,
+    )
+    assert got_id == proc_id, (got_id, proc_id)
+    devices = jax.devices()
+    assert len(devices) == 2 * num_procs, devices  # 2 local CPU devs each
+
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from hpbandster_tpu.core.result import json_result_logger
+    from hpbandster_tpu.optimizers import BOHB
+    from hpbandster_tpu.parallel import VmapBackend
+    from tests.toys import branin_from_vector, branin_space
+
+    mesh = Mesh(np.asarray(devices), axis_names=("config",))
+    cs = branin_space(seed=0)
+    backend = VmapBackend(branin_from_vector, mesh=mesh)
+    assert backend._multiprocess
+    executor = MultiHostBatchedExecutor(backend, cs)
+    assert executor.primary == (proc_id == 0)
+    assert is_primary_host() == (proc_id == 0)
+
+    logger = None
+    if executor.primary:
+        logger = json_result_logger(
+            os.path.join(outdir, "logged"), overwrite=True
+        )
+    opt = BOHB(
+        configspace=cs,
+        run_id="dcn-test",
+        executor=executor,
+        min_budget=1,
+        max_budget=9,
+        eta=3,
+        seed=0,
+        min_points_in_model=4,
+        result_logger=logger,
+    )
+    res = opt.run(n_iterations=3)
+    opt.shutdown()
+
+    # promotion decisions == the full (config_id, budget, loss) record
+    runs = sorted(
+        (list(r.config_id), float(r.budget), float(r.loss))
+        for r in res.get_all_runs()
+        if r.loss is not None
+    )
+    with open(os.path.join(outdir, f"runs_{proc_id}.json"), "w") as f:
+        json.dump(runs, f)
+    print(f"proc {proc_id}: OK ({len(runs)} runs)")
+
+
+if __name__ == "__main__":
+    main()

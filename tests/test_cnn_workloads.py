@@ -68,6 +68,7 @@ class TestCNNWorkload:
         assert all(np.isfinite(v) for v in vals)
 
 
+@pytest.mark.slow
 class TestResNetWorkload:
     @pytest.fixture(scope="class")
     def eval_fn(self):
@@ -109,6 +110,7 @@ class TestResNetWorkload:
 
 
 class TestEndToEndCNNSweep:
+    @pytest.mark.slow
     def test_hyperband_on_cnn(self):
         """Full HyperBand bracket over the batched CNN trainer."""
         from hpbandster_tpu.optimizers import HyperBand

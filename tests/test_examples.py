@@ -45,6 +45,8 @@ def test_example_2_threads():
     assert "best:" in out
 
 
+@pytest.mark.slow
+
 def test_example_3_processes():
     out = run_example(
         "example_3_local_parallel_processes.py", "--n_workers", "2",
@@ -52,6 +54,8 @@ def test_example_3_processes():
     )
     assert "best:" in out
 
+
+@pytest.mark.slow
 
 def test_example_5_mlp_worker():
     out = run_example(
@@ -61,6 +65,8 @@ def test_example_5_mlp_worker():
     assert "val loss at max budget" in out
 
 
+@pytest.mark.slow
+
 def test_example_6_analysis_warmstart(tmp_path):
     out = run_example(
         "example_6_analysis_warmstart.py", "--out_dir", str(tmp_path), "--plot",
@@ -68,6 +74,8 @@ def test_example_6_analysis_warmstart(tmp_path):
     assert "phase 3 final incumbent loss" in out
     assert (tmp_path / "losses_over_time.png").exists()
 
+
+@pytest.mark.slow
 
 def test_example_7_tpu_batched():
     out = run_example(

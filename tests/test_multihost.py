@@ -1,0 +1,72 @@
+"""DCN-tier integration test (VERDICT r1 #4): two real jax.distributed
+processes on the CPU backend run the SPMD-driver BOHB sweep end-to-end.
+
+Asserts the two hosts reach bit-identical promotion decisions and that only
+process 0 writes result logs — executing parallel/multihost.py rather than
+just documenting it (SURVEY.md §4 last bullet: multi-host tests via
+jax.distributed on CPU backends)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = os.path.join(REPO, "tests", "multihost_child.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_spmd_bohb(tmp_path):
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # 2 local devices per process -> 4-device global mesh over 2 hosts
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, CHILD, coordinator, "2", str(i), str(tmp_path)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+
+    with open(tmp_path / "runs_0.json") as f:
+        runs0 = json.load(f)
+    with open(tmp_path / "runs_1.json") as f:
+        runs1 = json.load(f)
+    assert len(runs0) > 0
+    # identical promotion decisions on both hosts (SPMD determinism)
+    assert runs0 == runs1
+
+    # only process 0 logs: the logger dir exists (created by proc 0) and
+    # nothing else in outdir beyond it and the two run dumps
+    logged = tmp_path / "logged"
+    assert (logged / "results.json").exists()
+    assert (logged / "configs.json").exists()
+    entries = sorted(os.listdir(tmp_path))
+    assert entries == ["logged", "runs_0.json", "runs_1.json"]

@@ -89,14 +89,110 @@ class TestSpaceCodec:
             else:
                 assert (v[:, j] == 0).all()
 
-    def test_conditional_space_rejected(self):
+    def test_conditional_space_compiles(self):
+        # conditions are supported on-device via compile_active_mask
+        # (VERDICT r1: this used to assert rejection — stale)
+        from hpbandster_tpu.ops.sweep import compile_active_mask
+
         cs = ConfigurationSpace(seed=0)
         a = CategoricalHyperparameter("a", ["x", "y"])
         b = UniformFloatHyperparameter("b", 0, 1)
         cs.add_hyperparameters([a, b])
         cs.add_condition(EqualsCondition(b, a, "x"))
-        with pytest.raises(ValueError, match="condition"):
-            build_space_codec(cs)
+        codec = build_space_codec(cs)
+        mask_fn = compile_active_mask(cs, codec)
+        q = quantize_unit(codec, random_unit(codec, jax.random.key(0), 16))
+        act = np.asarray(jax.vmap(mask_fn)(q))
+        assert act.shape == (16, 2)
+        assert act[:, 0].all()  # unconditional parent always active
+        # child active exactly when parent decodes to choice "x" (index 0)
+        ai = cs.get_hyperparameter_names().index("a")
+        assert (act[:, 1] == (np.asarray(q)[:, ai] == 0)).all()
+
+    def test_forbidden_mask_matches_host_is_forbidden(self):
+        from hpbandster_tpu.ops.sweep import compile_forbidden_mask
+        from hpbandster_tpu.space import (
+            ForbiddenAndConjunction,
+            ForbiddenEqualsClause,
+            ForbiddenInClause,
+        )
+
+        cs = ConfigurationSpace(seed=0)
+        a = CategoricalHyperparameter("a", ["x", "y", "z"])
+        b = UniformIntegerHyperparameter("b", 1, 4)
+        c = UniformFloatHyperparameter("c", 0.0, 1.0)
+        cs.add_hyperparameters([a, b, c])
+        cs.add_forbidden_clause(
+            ForbiddenAndConjunction(
+                ForbiddenEqualsClause(a, "x"), ForbiddenEqualsClause(b, 2)
+            )
+        )
+        cs.add_forbidden_clause(ForbiddenInClause(b, [4]))
+        codec = build_space_codec(cs)
+        fb_fn = compile_forbidden_mask(cs, codec)
+
+        q = np.asarray(
+            quantize_unit(codec, random_unit(codec, jax.random.key(3), 256))
+        )
+        act = jnp.ones(q.shape, bool)
+        dev = np.asarray(
+            jax.vmap(lambda v, a: fb_fn(v, a))(jnp.asarray(q), act)
+        )
+        host = np.array(
+            [cs.is_forbidden(dict(cs.from_vector(v))) for v in q]
+        )
+        np.testing.assert_array_equal(dev, host)
+        assert host.any() and not host.all()  # fixture exercises both sides
+
+    @pytest.mark.slow
+    def test_fused_run_on_forbidden_space(self):
+        from hpbandster_tpu.space import ForbiddenEqualsClause
+
+        cs = ConfigurationSpace(seed=0)
+        cs.add_hyperparameters(
+            [
+                UniformFloatHyperparameter("x", -5.0, 10.0),
+                UniformFloatHyperparameter("y", 0.0, 15.0),
+                CategoricalHyperparameter("arm", ["p", "q", "r"]),
+            ]
+        )
+        cs.add_forbidden_clause(
+            ForbiddenEqualsClause(cs.get_hyperparameter("arm"), "q")
+        )
+
+        def eval_fn(vec, budget):
+            return branin_from_vector(vec[:2], budget) + vec[2]
+
+        opt = FusedBOHB(
+            configspace=cs, eval_fn=eval_fn, run_id="forbidden",
+            min_budget=1, max_budget=9, eta=3, seed=0,
+            min_points_in_model=5,
+        )
+        res = opt.run(n_iterations=3)
+        opt.shutdown()
+        runs = res.get_all_runs()
+        assert len(runs) > 0
+        id2c = res.get_id2config_mapping()
+        # every evaluated config respects the forbidden clause (the device
+        # resampler replicates host rejection-sampling semantics)
+        for cid, entry in id2c.items():
+            assert not cs.is_forbidden(entry["config"]), entry["config"]
+            assert entry["config"]["arm"] in ("p", "r")
+
+    def test_order_condition_on_categorical_parent_rejected(self):
+        # a categorical's decoded number is its choice index; comparing a
+        # raw value against an index would be silently wrong on device
+        from hpbandster_tpu.ops.sweep import compile_active_mask
+        from hpbandster_tpu.space import GreaterThanCondition
+
+        cs = ConfigurationSpace(seed=0)
+        a = CategoricalHyperparameter("a", [4, 2, 8])
+        b = UniformFloatHyperparameter("b", 0, 1)
+        cs.add_hyperparameters([a, b])
+        cs.add_condition(GreaterThanCondition(b, a, 4))
+        codec = build_space_codec(cs)
+        with pytest.raises(ValueError, match="categorical"):
+            compile_active_mask(cs, codec)
 
 
 class TestDeviceKDEFit:
@@ -251,6 +347,7 @@ class TestFusedSweep:
         # bracket 0 samples before any observations exist: all random
         assert all(cid[0] > 0 for cid in mb)
 
+    @pytest.mark.slow
     def test_beats_random_search(self):
         """Sample-efficiency sanity: fused BOHB's best should not lose badly
         to random search with the same total evaluation count."""
@@ -283,6 +380,7 @@ class TestFusedSweep:
         assert len(res.get_all_runs()) > 0
         assert all(np.isfinite(r.loss) for r in res.get_all_runs())
 
+    @pytest.mark.slow
     def test_fused_sweep_on_cnn_training_workload(self):
         """Real training workload on the fused path: budget (= SGD steps)
         arrives as a concrete Python float inside the trace; the CNN's
@@ -326,6 +424,7 @@ class TestFusedSweep:
             c["config_info"].get("model_based_pick") for c in id2conf.values()
         ), "pallas-scored sweep produced no model-based picks"
 
+    @pytest.mark.slow
     def test_hartmann6_fused_sweep_converges(self):
         """BASELINE rung 2: 6-D Hartmann on the fused path."""
         from hpbandster_tpu.workloads.toys import (
@@ -358,6 +457,7 @@ class TestFusedSweep:
             found.extend(files)
         assert found, "no profiler trace files written"
 
+    @pytest.mark.slow
     def test_fused_sweep_on_resnet_workload(self):
         """BASELINE rung 5 on the fused path (tiny shapes)."""
         from hpbandster_tpu.workloads import (
@@ -457,6 +557,7 @@ class TestFusedSweep:
         assert inf_runs, "expected some diverged (+inf) runs"
         assert all(r.loss is not None for r in runs)
 
+    @pytest.mark.slow
     def test_chunked_run_matches_structure_and_carries_model(self):
         """chunk_brackets=K: same SH arithmetic as the monolithic program,
         and later chunks' proposals are model-based (obs threaded through
@@ -495,6 +596,7 @@ class TestFusedSweep:
         ]
         assert mb_third, "third bracket ignored earlier results"
 
+    @pytest.mark.slow
     def test_warmstart_from_previous_result(self):
         """previous_result= seeds the device observation buffers: bracket 0
         of the warm run can already make model-based picks, and the old data
@@ -521,6 +623,7 @@ class TestFusedSweep:
         ]
         assert mb0, "warm start did not enable model-based picks in bracket 0"
 
+    @pytest.mark.slow
     def test_chained_warmstart_no_id_collision(self):
         """Warm-starting from an already-warm-started Result must never remap
         old ids onto live bracket ids."""
