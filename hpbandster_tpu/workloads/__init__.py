@@ -37,3 +37,11 @@ from hpbandster_tpu.workloads.mlp import (  # noqa: F401
     mlp_forward,
     mlp_space,
 )
+from hpbandster_tpu.workloads.teacher import (  # noqa: F401
+    TARGET_VAL_ACCURACY,
+    TeacherConfig,
+    make_teacher_accuracy_fn,
+    make_teacher_dataset,
+    make_teacher_eval_fn,
+    teacher_space,
+)
