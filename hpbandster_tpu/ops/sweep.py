@@ -20,8 +20,14 @@ generator", §3.4): per-budget good/bad KDE split at ``top_n_percent``,
 ``min_points_in_model`` gate, largest-trained-budget model selection,
 ``random_fraction`` interleave, truncnorm-around-good-points candidates
 scored by ``l(x)/g(x)``, crashed runs recorded as maximally bad. Conditional
-spaces are NOT supported here (condition evaluation is host logic); the
-per-bracket path remains the fallback.
+spaces ARE supported: the condition DAG compiles to an on-device activity
+predicate (:func:`compile_active_mask`), inactive dims evaluate as 0 and are
+donor-imputed before KDE fits (host parity with
+``BOHBKDE.impute_conditional_data``); forbidden clauses compile to a device
+predicate with in-trace rejection resampling
+(:func:`compile_forbidden_mask`). Condition forms without a numeric device
+representation (e.g. order comparisons on categorical parents) raise at
+construction — the per-bracket path remains the fallback for those.
 """
 
 from __future__ import annotations
@@ -42,8 +48,9 @@ __all__ = ["SpaceCodec", "build_space_codec", "quantize_unit", "random_unit",
 
 
 class SpaceCodec(NamedTuple):
-    """Static per-dim description of a condition-free search space, enough to
-    quantize and sample unit-hypercube vectors entirely on-device.
+    """Static per-dim description of a search space, enough to quantize and
+    sample unit-hypercube vectors entirely on-device (conditions and
+    forbiddens live in separately compiled predicates, not in the codec).
 
     Built host-side from a ``ConfigurationSpace`` (:func:`build_space_codec`)
     and closed over at trace time — all arrays are plain numpy.
@@ -136,8 +143,9 @@ def _int_log_bounds(codec: SpaceCodec) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def quantize_unit(codec: SpaceCodec, u: jax.Array) -> jax.Array:
-    """Jittable twin of host ``to_vector(from_vector(u))`` for condition-free
-    spaces: snap unit-hypercube vectors to representable configurations.
+    """Jittable twin of host ``to_vector(from_vector(u))``: snap
+    unit-hypercube vectors to representable configurations. (Activity of
+    conditional dims is decided separately by :func:`compile_active_mask`.)
 
     ``u`` is ``f32[..., d]``. Bit-level parity with the host codec is not
     required (both are fixed points of each other's rounding; the bin-center

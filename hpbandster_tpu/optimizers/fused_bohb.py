@@ -9,9 +9,12 @@ and visualization tooling see exactly the structures the reference produces
 (SURVEY.md §2 "Result / logging"), while the optimization itself pays one
 device dispatch + one result fetch for the whole run.
 
-Use this when the objective is jittable and the space is condition-free;
-otherwise use ``BOHB`` with a ``BatchedExecutor`` (per-bracket fusion) or
-the host worker pool.
+Use this whenever the objective is jittable — conditional spaces and
+forbidden clauses are supported on-device (``ops/sweep.py``:
+``compile_active_mask`` / ``compile_forbidden_mask``). Fall back to ``BOHB``
+with a ``BatchedExecutor`` (per-bracket fusion) or the host worker pool for
+non-jittable objectives, or for the rare condition forms without a device
+representation (construction raises ``ValueError`` for those).
 """
 
 from __future__ import annotations

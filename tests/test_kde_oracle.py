@@ -169,13 +169,28 @@ class TestPdfAndScoreOracle:
         score = max(lg, LOG_PDF_FLOOR) - max(lb, LOG_PDF_FLOOR)
         np.testing.assert_allclose(score, GOLD_SCORE, rtol=1e-4)
 
-    def test_fused_sweep_kde_fit_matches_goldens(self):
-        # the fused tracer's fit (ops.sweep._fit_kde_pair_device) routes
-        # through the same normal_reference_bandwidths — pin it to the
-        # oracle too so a drive-by refactor can't silently fork the paths
-        from hpbandster_tpu.ops.sweep import _fit_kde_pair_device  # noqa: F401
+    @pytest.mark.parametrize("perm", [[0, 1, 2, 3, 4], [3, 0, 4, 2, 1]])
+    def test_fused_sweep_kde_fit_matches_goldens(self, perm):
+        # the fused tracer's fit must reproduce the statsmodels goldens
+        # NUMERICALLY (VERDICT r2 #8): feed the 5-point fixture with losses
+        # ranking rows 0-2 good / 3-4 bad — in order and shuffled, so a
+        # wrong sort, mask, or weighting inside _fit_kde_pair_device fails
+        from hpbandster_tpu.ops.sweep import _fit_kde_pair_device
 
-        import inspect
-
-        src = inspect.getsource(_fit_kde_pair_device)
-        assert "normal_reference_bandwidths" in src
+        perm = np.asarray(perm)
+        losses = np.asarray([0.1, 0.2, 0.3, 0.8, 0.9], np.float32)
+        good, bad = _fit_kde_pair_device(
+            jnp.asarray(DATA[perm]),
+            jnp.asarray(losses[perm]),
+            n_good=3,
+            n_bad=2,
+            cards=jnp.asarray(CARDS),
+            min_bandwidth=1e-3,
+        )
+        np.testing.assert_allclose(np.asarray(good.bw), GOLD_BW_GOOD, rtol=2e-6)
+        np.testing.assert_allclose(np.asarray(bad.bw), GOLD_BW_BAD, rtol=2e-6)
+        vt, cd = jnp.asarray(VARTYPES), jnp.asarray(CARDS)
+        lg = float(kde_logpdf(jnp.asarray(QUERY), good, vt, cd))
+        lb = float(kde_logpdf(jnp.asarray(QUERY), bad, vt, cd))
+        np.testing.assert_allclose(lg, math.log(GOLD_PDF_GOOD), rtol=1e-5)
+        np.testing.assert_allclose(lb, math.log(GOLD_PDF_BAD), rtol=1e-5)

@@ -179,6 +179,69 @@ class TestSpaceCodec:
             assert not cs.is_forbidden(entry["config"]), entry["config"]
             assert entry["config"]["arm"] in ("p", "r")
 
+    def test_fused_run_on_conditional_space_matches_host_semantics(self):
+        # VERDICT r2 #2: the fused tier's conditional support, end to end —
+        # EqualsCondition on a categorical parent PLUS an order condition on
+        # a numeric ordinal parent, through KDE-model-based brackets (the
+        # conditional imputation path), with host-parity assertions on every
+        # produced config's activity pattern.
+        from hpbandster_tpu.ops.sweep import compile_active_mask
+        from hpbandster_tpu.space import GreaterThanCondition
+
+        cs = ConfigurationSpace(seed=0)
+        x = UniformFloatHyperparameter("x", -5.0, 10.0)
+        y = UniformFloatHyperparameter("y", 0.0, 15.0)
+        opt_hp = CategoricalHyperparameter("opt", ["sgd", "adam"])
+        mom = UniformFloatHyperparameter("momentum", 0.0, 0.99)
+        depth = OrdinalHyperparameter("depth", [1, 2, 4, 8])
+        extra = UniformFloatHyperparameter("extra", 0.0, 1.0)
+        cs.add_hyperparameters([x, y, opt_hp, mom, depth, extra])
+        cs.add_condition(EqualsCondition(mom, opt_hp, "sgd"))
+        cs.add_condition(GreaterThanCondition(extra, depth, 2))
+
+        names = cs.get_hyperparameter_names()
+        i_mom, i_extra = names.index("momentum"), names.index("extra")
+
+        def eval_fn(vec, budget):
+            # inactive dims reach evaluation as 0.0 (host parity)
+            return (
+                branin_from_vector(vec[:2], budget)
+                + 0.1 * vec[i_mom]
+                + 0.05 * vec[i_extra]
+            )
+
+        opt = FusedBOHB(
+            configspace=cs, eval_fn=eval_fn, run_id="conditional",
+            min_budget=1, max_budget=9, eta=3, seed=3,
+            min_points_in_model=6,
+        )
+        res = opt.run(n_iterations=3)
+        opt.shutdown()
+
+        runs = res.get_all_runs()
+        assert len(runs) == 13 + 6 + 3  # SH arithmetic intact (eta=3, 1..9)
+        id2c = res.get_id2config_mapping()
+        mask_fn = compile_active_mask(cs, opt.codec)
+        for cid, entry in id2c.items():
+            cfg = entry["config"]
+            # host activity semantics hold exactly: round-tripping through
+            # the host codec neither prunes nor resurrects any key
+            host_vec = cs.to_vector(cfg)
+            assert dict(cs.from_vector(host_vec)) == cfg, cfg
+            assert ("momentum" in cfg) == (cfg["opt"] == "sgd"), cfg
+            assert ("extra" in cfg) == (cfg["depth"] > 2), cfg
+            # device activity mask agrees with the host NaN pattern
+            q = jnp.asarray(np.nan_to_num(host_vec, nan=0.0), jnp.float32)
+            dev_active = np.asarray(mask_fn(q))
+            np.testing.assert_array_equal(
+                dev_active, ~np.isnan(host_vec), err_msg=str(cfg)
+            )
+        # the KDE engaged on the conditional space (imputation path traced
+        # AND executed): later brackets carry model-based picks
+        assert any(
+            e["config_info"].get("model_based_pick") for e in id2c.values()
+        )
+
     def test_order_condition_on_categorical_parent_rejected(self):
         # a categorical's decoded number is its choice index; comparing a
         # raw value against an index would be silently wrong on device
