@@ -17,7 +17,16 @@ from typing import Any, Dict
 
 from hpbandster_tpu.core.iteration import Datum, Status
 
-__all__ = ["master_state_dict", "restore_master_state", "save_checkpoint", "load_checkpoint"]
+__all__ = [
+    "master_state_dict",
+    "restore_master_state",
+    "save_checkpoint",
+    "load_checkpoint",
+    "fused_state_dict",
+    "restore_fused_state",
+    "save_fused_checkpoint",
+    "load_fused_checkpoint",
+]
 
 _FORMAT_VERSION = 1
 
@@ -32,27 +41,60 @@ def _datum_state(d: Datum) -> Dict[str, Any]:
         "results": d.results,
         "time_stamps": d.time_stamps,
         "exceptions": d.exceptions,
+        "infos": d.infos,
         "status": int(status),
         "budget": d.budget,
     }
 
 
+def _iteration_state(it) -> Dict[str, Any]:
+    return {
+        "HPB_iter": it.HPB_iter,
+        "num_configs": list(it.num_configs),
+        "budgets": list(it.budgets),
+        "stage": it.stage,
+        "actual_num_configs": list(it.actual_num_configs),
+        "is_finished": it.is_finished,
+        "data": {cid: _datum_state(d) for cid, d in it.data.items()},
+    }
+
+
+def _restore_iteration(it, it_state: Dict[str, Any]) -> None:
+    it.stage = it_state["stage"]
+    it.actual_num_configs = list(it_state["actual_num_configs"])
+    it.is_finished = it_state["is_finished"]
+    it.num_running = 0
+    it.data = {}
+    for cid, ds in it_state["data"].items():
+        d = Datum(
+            config=ds["config"],
+            config_info=ds["config_info"],
+            results=ds["results"],
+            time_stamps=ds["time_stamps"],
+            exceptions=ds["exceptions"],
+            status=Status(ds["status"]),
+            budget=ds["budget"],
+        )
+        d.infos = dict(ds.get("infos", {}))
+        it.data[tuple(cid)] = d
+
+
+def _check_iteration_shape(it, it_state: Dict[str, Any]) -> None:
+    if list(it.num_configs) != it_state["num_configs"] or [
+        float(b) for b in it.budgets
+    ] != it_state["budgets"]:
+        raise ValueError(
+            f"iteration {it_state['HPB_iter']} shape mismatch: checkpoint "
+            f"{it_state['num_configs']}@{it_state['budgets']} vs "
+            f"{list(it.num_configs)}@{list(it.budgets)} — was the "
+            "optimizer constructed with different eta/budget settings?"
+        )
+
+
 def master_state_dict(master) -> Dict[str, Any]:
     """Snapshot a Master (under its own lock) into a picklable dict."""
     with master.thread_cond:
-        iterations = []
-        for it in master.iterations:
-            iterations.append(
-                {
-                    "HPB_iter": it.HPB_iter,
-                    "num_configs": list(it.num_configs),
-                    "budgets": list(it.budgets),
-                    "stage": it.stage,
-                    "actual_num_configs": list(it.actual_num_configs),
-                    "is_finished": it.is_finished,
-                    "data": {cid: _datum_state(d) for cid, d in it.data.items()},
-                }
-            )
+        iterations = [_iteration_state(it) for it in master.iterations]
         state = {
             "format_version": _FORMAT_VERSION,
             "config": dict(master.config),
@@ -73,6 +115,10 @@ def restore_master_state(master, state: Dict[str, Any]) -> None:
     """
     if state.get("format_version") != _FORMAT_VERSION:
         raise ValueError(f"unsupported checkpoint version {state.get('format_version')}")
+    if state.get("kind") == "fused":
+        raise ValueError(
+            "fused-tier checkpoint (use FusedBOHB.load_checkpoint)"
+        )
     with master.thread_cond:
         if master.iterations:
             raise RuntimeError("can only restore into a fresh Master")
@@ -86,45 +132,119 @@ def restore_master_state(master, state: Dict[str, Any]) -> None:
             it = master.get_next_iteration(
                 it_state["HPB_iter"], {"result_logger": master.result_logger}
             )
-            if list(it.num_configs) != it_state["num_configs"] or [
-                float(b) for b in it.budgets
-            ] != it_state["budgets"]:
-                raise ValueError(
-                    f"iteration {it_state['HPB_iter']} shape mismatch: checkpoint "
-                    f"{it_state['num_configs']}@{it_state['budgets']} vs "
-                    f"{list(it.num_configs)}@{list(it.budgets)} — was the "
-                    "optimizer constructed with different eta/budget settings?"
-                )
-            it.stage = it_state["stage"]
-            it.actual_num_configs = list(it_state["actual_num_configs"])
-            it.is_finished = it_state["is_finished"]
-            it.num_running = 0
-            it.data = {
-                tuple(cid): Datum(
-                    config=ds["config"],
-                    config_info=ds["config_info"],
-                    results=ds["results"],
-                    time_stamps=ds["time_stamps"],
-                    exceptions=ds["exceptions"],
-                    status=Status(ds["status"]),
-                    budget=ds["budget"],
-                )
-                for cid, ds in it_state["data"].items()
-            }
+            _check_iteration_shape(it, it_state)
+            _restore_iteration(it, it_state)
             master.iterations.append(it)
 
 
-def save_checkpoint(master, path: str) -> None:
-    state = master_state_dict(master)
+def fused_state_dict(opt) -> Dict[str, Any]:
+    """Snapshot a FusedBOHB-family optimizer at a chunk boundary.
+
+    Captures everything the next chunk's device computation consumes: the
+    replayed bracket bookkeeping (for the final ``Result``), the warm
+    observation buffers (the device model's entire memory), the bracket
+    rotation position, and the numpy RNG state — so a resumed run draws the
+    SAME chunk seeds an uninterrupted run would have drawn.
+    """
+    import numpy as np
+
+    return {
+        "format_version": _FORMAT_VERSION,
+        "kind": "fused",
+        "config": dict(opt.config),
+        "iterations": [_iteration_state(it) for it in opt.iterations],
+        "warm_v": {b: np.asarray(v) for b, v in opt._warm_v.items()},
+        "warm_l": {b: np.asarray(l) for b, l in opt._warm_l.items()},
+        "rng_state": opt.rng.bit_generator.state,
+        "total_evaluated": opt.total_evaluated,
+        "run_stats": list(opt.run_stats),
+    }
+
+
+def restore_fused_state(opt, state: Dict[str, Any]) -> None:
+    """Rehydrate a freshly-constructed fused optimizer from
+    :func:`fused_state_dict`; the next ``run()`` continues with the
+    remaining brackets (same constructor args required — shapes verified)."""
+    from hpbandster_tpu.core.successive_halving import SuccessiveHalving
+
+    if state.get("format_version") != _FORMAT_VERSION:
+        raise ValueError(
+            f"unsupported checkpoint version {state.get('format_version')}"
+        )
+    if state.get("kind") != "fused":
+        raise ValueError("not a fused-tier checkpoint (use load_checkpoint)")
+    if opt.iterations:
+        raise RuntimeError("can only restore into a fresh optimizer")
+    # bracket shapes alone don't pin the optimizer's behavior — the KDE
+    # knobs (num_samples, top_n_percent, ...) must match too, or the
+    # resumed run silently diverges while its artifacts report the
+    # checkpoint's values
+    ckpt_knobs = {k: v for k, v in state["config"].items() if k != "time_ref"}
+    mine = {k: v for k, v in opt.config.items() if k != "time_ref"}
+    if ckpt_knobs != mine:
+        diff = sorted(
+            k
+            for k in set(ckpt_knobs) | set(mine)
+            if ckpt_knobs.get(k) != mine.get(k)
+        )
+        raise ValueError(
+            f"checkpoint optimizer settings differ from constructor "
+            f"settings in {diff} — resume requires identical knobs"
+        )
+
+    def no_sampler(budget):
+        raise RuntimeError("restored fused brackets must not sample configs")
+
+    # build + validate everything BEFORE touching the optimizer, so a shape
+    # mismatch leaves it untouched (and retryable with the right checkpoint)
+    restored = []
+    for it_state in state["iterations"]:
+        plan = opt._plan(it_state["HPB_iter"])
+        it = SuccessiveHalving(
+            HPB_iter=it_state["HPB_iter"],
+            num_configs=list(plan.num_configs),
+            budgets=list(plan.budgets),
+            config_sampler=no_sampler,
+            result_logger=opt.result_logger,
+        )
+        _check_iteration_shape(it, it_state)
+        _restore_iteration(it, it_state)
+        restored.append(it)
+    opt.config.update(state["config"])
+    opt.iterations.extend(restored)
+    opt._warm_v = {float(b): v for b, v in state["warm_v"].items()}
+    opt._warm_l = {float(b): l for b, l in state["warm_l"].items()}
+    opt.rng.bit_generator.state = state["rng_state"]
+    opt.total_evaluated = int(state["total_evaluated"])
+    # resumed chunks continue the chunk numbering and keep the dead run's
+    # timing trail — fused_timings.json stays a complete artifact record
+    opt.run_stats = list(state.get("run_stats", []))
+
+
+def _atomic_pickle(state: Dict[str, Any], path: str) -> None:
+    import os
+
     tmp = f"{path}.tmp"
     with open(tmp, "wb") as fh:
         pickle.dump(state, fh)
-    import os
-
     os.replace(tmp, path)  # atomic: a crash mid-write never corrupts
+
+
+def save_checkpoint(master, path: str) -> None:
+    _atomic_pickle(master_state_dict(master), path)
 
 
 def load_checkpoint(master, path: str) -> None:
     with open(path, "rb") as fh:
         state = pickle.load(fh)
     restore_master_state(master, state)
+
+
+def save_fused_checkpoint(opt, path: str) -> None:
+    _atomic_pickle(fused_state_dict(opt), path)
+
+
+def load_fused_checkpoint(opt, path: str) -> None:
+    with open(path, "rb") as fh:
+        state = pickle.load(fh)
+    restore_fused_state(opt, state)
