@@ -10,11 +10,14 @@ from hpbandster_tpu.workloads.toys import (  # noqa: F401
     hartmann6_space,
 )
 from hpbandster_tpu.workloads.cnn import (  # noqa: F401
+    CNN_TARGET_VAL_ACCURACY,
     CNNConfig,
     cnn_forward,
     cnn_space,
     decode_cnn_hparams,
     init_cnn_params,
+    make_cnn_accuracy_fn,
+    make_cnn_error_fn,
     make_cnn_eval_fn,
     make_image_dataset,
 )

@@ -55,6 +55,10 @@ class ResNetConfig(NamedTuple):
     n_val: int = 256
     batch_size: int = 128
     groups: int = 8          # GroupNorm groups (must divide every stage width)
+    #: generalization-axis knobs, shared with the CNN rung's dataset
+    #: (train-only label noise + image-noise ceiling; VERDICT r2 #9)
+    label_noise: float = 0.05
+    image_noise: float = 2.0
 
 
 def resnet_space(seed=None) -> ConfigurationSpace:
@@ -175,6 +179,8 @@ def make_resnet_eval_fn(cfg: ResNetConfig = ResNetConfig(), data_seed: int = 0):
         n_train=cfg.n_train,
         n_val=cfg.n_val,
         batch_size=cfg.batch_size,
+        label_noise=cfg.label_noise,
+        image_noise=cfg.image_noise,
     )
     train, (x_v, y_v) = make_image_dataset(jax.random.key(data_seed), data_cfg)
     init_key = jax.random.key(data_seed + 1)

@@ -11,23 +11,29 @@ import numpy as np
 import pytest
 
 from hpbandster_tpu.workloads import (
+    CNN_TARGET_VAL_ACCURACY,
     CNNConfig,
     ResNetConfig,
     cnn_space,
     init_resnet_params,
+    make_cnn_accuracy_fn,
+    make_cnn_error_fn,
     make_cnn_eval_fn,
+    make_image_dataset,
     make_resnet_eval_fn,
     resnet_forward,
     resnet_space,
 )
 
+# tiny shapes are contract fixtures, not learning benchmarks: keep the
+# image noise at 1.0 so a fixed config still learns in a few dozen steps
 TINY_CNN = CNNConfig(
     image_size=8, channels=3, width=8, n_classes=4,
-    n_train=64, n_val=32, batch_size=32,
+    n_train=64, n_val=32, batch_size=32, image_noise=1.0,
 )
 TINY_RESNET = ResNetConfig(
     image_size=8, channels=3, width=8, n_classes=4,
-    n_train=64, n_val=32, batch_size=32, groups=4,
+    n_train=64, n_val=32, batch_size=32, groups=4, image_noise=1.0,
 )
 
 
@@ -107,6 +113,66 @@ class TestResNetWorkload:
         )(X, jnp.float32(3.0))
         assert losses.shape == (2,)
         assert np.isfinite(np.asarray(losses)).all()
+
+
+class TestCNNGeneralization:
+    """The conv rungs' generalization axis (VERDICT r2 #9): held-out split,
+    train-only label noise, documented target accuracy."""
+
+    def test_dataset_deterministic_with_heldout_split(self):
+        (xt, yt), (xv, yv) = make_image_dataset(jax.random.key(0), TINY_CNN)
+        (xt2, yt2), _ = make_image_dataset(jax.random.key(0), TINY_CNN)
+        np.testing.assert_array_equal(np.asarray(xt), np.asarray(xt2))
+        np.testing.assert_array_equal(np.asarray(yt), np.asarray(yt2))
+        assert xt.shape == (TINY_CNN.n_train, 8, 8, 3)
+        assert xv.shape == (TINY_CNN.n_val, 8, 8, 3)
+
+    def test_label_noise_applied_to_train_only(self):
+        cfg = CNNConfig(n_train=2048)  # enough rows to measure ~5% flips
+        clean = cfg._replace(label_noise=0.0)
+        (_, y_noisy), (_, yv_noisy) = make_image_dataset(jax.random.key(0), cfg)
+        (_, y_clean), (_, yv_clean) = make_image_dataset(jax.random.key(0), clean)
+        frac = float(np.mean(np.asarray(y_noisy) != np.asarray(y_clean)))
+        assert 0.02 < frac < 0.08, frac  # flips to the same class keep labels
+        np.testing.assert_array_equal(np.asarray(yv_noisy), np.asarray(yv_clean))
+
+    def test_error_fn_is_accuracy_twin(self):
+        err_fn = jax.jit(make_cnn_error_fn(TINY_CNN))
+        acc_fn = jax.jit(make_cnn_accuracy_fn(TINY_CNN))
+        vec = jnp.asarray([0.7, 0.9, 0.3, 0.5], jnp.float32)
+        _, va = acc_fn(vec, 20.0)
+        err = err_fn(vec, 20.0)
+        np.testing.assert_allclose(float(err), 1.0 - float(va), atol=1e-6)
+
+    @pytest.mark.slow
+    def test_bohb_incumbent_converges_on_generalization_axis(self):
+        # sweep-level convergence assertion, CPU-sized: a pinned-seed
+        # 2-bracket BOHB on a 16x16 config (measured: incumbent val acc
+        # 0.648 vs best-of-12-random 0.766 and ~0.10 chance). The full
+        # documented CNN_TARGET_VAL_ACCURACY assertion runs in bench.py on
+        # the TPU-sized default config, where a 65-eval sweep measured
+        # 0.746 >= 0.70 — this workload is needle-like (most draws stall
+        # at chance), which is exactly the landscape HPO exists for.
+        from hpbandster_tpu.optimizers import BOHB
+        from hpbandster_tpu.parallel import BatchedExecutor, VmapBackend
+
+        mid = CNNConfig(
+            image_size=16, width=16, n_train=256, n_val=128, batch_size=64
+        )
+        cs = cnn_space(seed=0)
+        opt = BOHB(
+            configspace=cs, run_id="cnn-gen",
+            executor=BatchedExecutor(VmapBackend(make_cnn_error_fn(mid)), cs),
+            min_budget=3, max_budget=81, eta=3, seed=0, min_points_in_model=5,
+        )
+        res = opt.run(n_iterations=2)
+        opt.shutdown()
+        traj = res.get_incumbent_trajectory()
+        best_acc = 1.0 - traj["losses"][-1]
+        assert best_acc >= 0.60, (
+            f"incumbent val acc {best_acc:.3f}: the sweep failed to climb "
+            f"the generalization axis (chance is ~0.10)"
+        )
 
 
 class TestEndToEndCNNSweep:

@@ -1,0 +1,162 @@
+"""Trickle-style model updates (VERDICT r1 #9 / r2 #5).
+
+The reference's BOHB refits its KDE after EVERY result, not at stage ends
+(SURVEY.md §3.3: ``new_result`` -> refit inside the result callback). On the
+host-pool tier results arrive one at a time, so proposals *within* a stage
+see a model that already includes the stage's earlier results. These tests
+pin that parity: the unit level (``BOHBKDE.new_result`` refits between two
+results of the same budget) and the tier level (a sequential RPC run shows
+the model version advancing between consecutive same-stage results).
+
+The measured trickle-vs-stage-chunked sample-efficiency comparison lives in
+``docs/best_practices.md`` ("Model update granularity"); regenerate it with
+``python -m tests.test_trickle`` (prints the table).
+"""
+
+import numpy as np
+import pytest
+
+from hpbandster_tpu.core.job import Job
+from hpbandster_tpu.models.bohb_kde import BOHBKDE
+
+from tests.toys import branin_dict, branin_from_vector, branin_space
+
+
+def _job(cfg, budget, loss):
+    j = Job((0, 0, 0), config=cfg, budget=budget)
+    j.result = {"loss": loss, "info": {}}
+    return j
+
+
+class TestTrickleRefits:
+    def test_new_result_refits_between_results_of_same_budget(self):
+        cs = branin_space(seed=0)
+        gen = BOHBKDE(configspace=cs, seed=0, min_points_in_model=3)
+        rng = np.random.default_rng(0)
+        budget = 1.0
+
+        gate = gen.min_points_in_model + 2  # _fit_kde_pair's training gate
+        pairs = []  # strong refs, so object identity is meaningful
+        for i in range(gate + 3):
+            cfg = dict(cs.sample_configuration(rng=rng))
+            gen.new_result(_job(cfg, budget, float(rng.uniform())))
+            pairs.append(gen.kde_models.get(budget))
+        # before the gate: no model; at the gate and after: a FRESH pair
+        # after every single result (trickle refit, not stage-chunked)
+        assert pairs[: gate - 1] == [None] * (gate - 1)
+        trained = pairs[gate - 1 :]
+        assert all(p is not None for p in trained)
+        assert all(
+            p2 is not p1 for p1, p2 in zip(trained, trained[1:])
+        ), "model did not refit between consecutive results"
+
+    def test_rpc_tier_model_advances_within_a_stage(self):
+        # host-pool tier, 1 worker => strictly sequential trickle. Record
+        # (budget, model-id) at every new_result; the model id must change
+        # between consecutive results of the same budget within a bracket.
+        from hpbandster_tpu.core.nameserver import NameServer
+        from hpbandster_tpu.core.worker import Worker
+        from hpbandster_tpu.optimizers import BOHB
+
+        class BraninWorker(Worker):
+            def compute(self, config_id, config, budget, working_directory):
+                return {"loss": branin_dict(config, budget), "info": {}}
+
+        ns = NameServer(run_id="trickle", host="127.0.0.1", port=0)
+        host, port = ns.start()
+        BraninWorker(
+            run_id="trickle", nameserver=host, nameserver_port=port, id=0
+        ).run(background=True)
+        opt = BOHB(
+            configspace=branin_space(seed=1), run_id="trickle",
+            nameserver=host, nameserver_port=port,
+            min_budget=1, max_budget=9, eta=3, seed=1, min_points_in_model=3,
+        )
+        events = []
+        gen = opt.config_generator
+        orig = gen.new_result
+
+        def spy(job, update_model=True):
+            orig(job, update_model=update_model)
+            b = float(job.kwargs["budget"])
+            # hold the pair itself: ids of collected objects get recycled
+            events.append((b, gen.kde_models.get(b)))
+
+        gen.new_result = spy
+        opt.run(n_iterations=4, min_n_workers=1)
+        opt.shutdown(shutdown_workers=True)
+        ns.shutdown()
+
+        assert len(events) >= 10
+        advanced_within_budget = sum(
+            1
+            for (b1, m1), (b2, m2) in zip(events, events[1:])
+            if b1 == b2 and m1 is not None and m2 is not None and m1 is not m2
+        )
+        # the model advanced between consecutive same-budget results —
+        # i.e. mid-stage, not only at stage boundaries
+        assert advanced_within_budget >= 3, events
+
+
+def measure(seeds=range(8), n_iterations=4):
+    """Trickle (sequential host pool) vs stage-chunked (batched executor)
+    sample efficiency at identical seeds/budgets; prints the
+    docs/best_practices.md table."""
+    from hpbandster_tpu.core.nameserver import NameServer
+    from hpbandster_tpu.core.worker import Worker
+    from hpbandster_tpu.optimizers import BOHB
+    from hpbandster_tpu.parallel import BatchedExecutor, VmapBackend
+
+    class BraninWorker(Worker):
+        def compute(self, config_id, config, budget, working_directory):
+            return {"loss": branin_dict(config, budget), "info": {}}
+
+    def best(res):
+        # sample-efficiency metric: the NOISE-FREE Branin value of the
+        # incumbent (min over all budgets rewards low-fidelity noise, which
+        # would measure luck, not model quality)
+        cfg = res.get_id2config_mapping()[res.get_incumbent_id()]["config"]
+        return branin_dict(cfg, budget=1e12)
+
+    trickle, chunked, n_evals = [], [], None
+    for seed in seeds:
+        ns = NameServer(run_id=f"m{seed}", host="127.0.0.1", port=0)
+        host, port = ns.start()
+        BraninWorker(
+            run_id=f"m{seed}", nameserver=host, nameserver_port=port, id=0
+        ).run(background=True)
+        opt = BOHB(
+            configspace=branin_space(seed=seed), run_id=f"m{seed}",
+            nameserver=host, nameserver_port=port,
+            min_budget=1, max_budget=9, eta=3, seed=seed,
+            min_points_in_model=3,
+        )
+        res = opt.run(n_iterations=n_iterations, min_n_workers=1)
+        n_evals = len(res.get_all_runs())
+        opt.shutdown(shutdown_workers=True)
+        ns.shutdown()
+        trickle.append(best(res))
+
+        cs = branin_space(seed=seed)
+        opt = BOHB(
+            configspace=cs, run_id=f"mc{seed}",
+            executor=BatchedExecutor(VmapBackend(branin_from_vector), cs),
+            min_budget=1, max_budget=9, eta=3, seed=seed,
+            min_points_in_model=3,
+        )
+        res = opt.run(n_iterations=n_iterations)
+        opt.shutdown()
+        chunked.append(best(res))
+
+    def stats(xs):
+        return float(np.median(xs)), float(np.mean(xs)), float(np.std(xs))
+
+    print(f"seeds={list(seeds)} evaluations/run={n_evals} (true optimum 0.397887)")
+    for name, xs in (("trickle", trickle), ("stage-chunked", chunked)):
+        med, mean, sd = stats(xs)
+        print(f"{name:>14}: median {med:.4f}  mean {mean:.4f} +/- {sd:.4f}")
+    return trickle, chunked
+
+
+if __name__ == "__main__":
+    measure()
