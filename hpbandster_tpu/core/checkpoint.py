@@ -137,6 +137,24 @@ def restore_master_state(master, state: Dict[str, Any]) -> None:
             master.iterations.append(it)
 
 
+def _rank_fn_name(fn) -> Any:
+    """Best-effort STABLE identity for a promotion-rank callable.
+
+    ``__qualname__`` when the callable has one (plain functions — the
+    FusedH2BO case), else the type's qualname (``functools.partial`` etc.).
+    Never ``repr``: that embeds a memory address, which would reject every
+    legitimate resume of a qualname-less callable. This guard catches
+    class/None mismatches and differently-NAMED functions; two distinct
+    callables of the same name (two lambdas, two partials) are on the
+    caller to keep consistent — same contract as the eval_fn itself, which
+    is not checkpointed at all.
+    """
+    if fn is None:
+        return None
+    name = getattr(fn, "__qualname__", None)
+    return name if name is not None else type(fn).__qualname__
+
+
 def fused_state_dict(opt) -> Dict[str, Any]:
     """Snapshot a FusedBOHB-family optimizer at a chunk boundary.
 
@@ -151,6 +169,13 @@ def fused_state_dict(opt) -> Dict[str, Any]:
     return {
         "format_version": _FORMAT_VERSION,
         "kind": "fused",
+        # opt.config alone cannot distinguish FusedBOHB from FusedH2BO
+        # (promotion_rank_fn is not a config knob) nor record the scorer
+        # backend — pin both so restore cannot silently switch promotion
+        # semantics mid-sweep (ADVICE r3)
+        "optimizer_class": type(opt).__name__,
+        "promotion_rank_fn": _rank_fn_name(opt.promotion_rank_fn),
+        "use_pallas": bool(opt.use_pallas),
         "config": dict(opt.config),
         "iterations": [_iteration_state(it) for it in opt.iterations],
         "warm_v": {b: np.asarray(v) for b, v in opt._warm_v.items()},
@@ -175,6 +200,31 @@ def restore_fused_state(opt, state: Dict[str, Any]) -> None:
         raise ValueError("not a fused-tier checkpoint (use load_checkpoint)")
     if opt.iterations:
         raise RuntimeError("can only restore into a fresh optimizer")
+    # class/semantics guard (ADVICE r3): a FusedH2BO checkpoint must not
+    # restore into a plain FusedBOHB — the remaining brackets would switch
+    # from LC-extrapolated to raw-loss promotion without any error. Old
+    # (round-3) checkpoints lack these keys; skip the guard for those.
+    if "optimizer_class" in state:
+        if state["optimizer_class"] != type(opt).__name__:
+            raise ValueError(
+                f"checkpoint was written by {state['optimizer_class']}, "
+                f"restoring into {type(opt).__name__} — promotion semantics "
+                "would silently change; construct the matching class"
+            )
+        mine_rank = _rank_fn_name(opt.promotion_rank_fn)
+        if state["promotion_rank_fn"] != mine_rank:
+            raise ValueError(
+                f"checkpoint promotion_rank_fn "
+                f"{state['promotion_rank_fn']!r} != optimizer's "
+                f"{mine_rank!r} — resume requires identical promotion "
+                "semantics"
+            )
+        if state["use_pallas"] != bool(opt.use_pallas):
+            raise ValueError(
+                f"checkpoint used use_pallas={state['use_pallas']}, "
+                f"optimizer has use_pallas={opt.use_pallas} — pass the "
+                "same scorer backend to resume"
+            )
     # bracket shapes alone don't pin the optimizer's behavior — the KDE
     # knobs (num_samples, top_n_percent, ...) must match too, or the
     # resumed run silently diverges while its artifacts report the

@@ -766,4 +766,19 @@ def make_fused_sweep_fn(
             )
         return outputs
 
+    from hpbandster_tpu.parallel.mesh import is_multiprocess_mesh
+
+    if is_multiprocess_mesh(mesh):
+        # DCN tier (VERDICT r3 #6): the mesh spans several jax.distributed
+        # processes. Every rank's SPMD driver replays the SAME sweep, so
+        # inputs (seed + warm observations, identical on all ranks) and
+        # outputs (the stage records every rank's bookkeeping consumes) pin
+        # to fully-REPLICATED shardings — a rank could not device_get a
+        # shard homed on another process. Evaluation still shards over the
+        # 'config' axis via the with_sharding_constraint above; XLA inserts
+        # the all-gathers (outputs are tiny: indices + losses + vectors).
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rep = NamedSharding(mesh, PartitionSpec())
+        return jax.jit(sweep, in_shardings=rep, out_shardings=rep)
     return jax.jit(sweep)

@@ -350,6 +350,9 @@ class FusedBOHB:
         if self.config["time_ref"] is None:
             self.config["time_ref"] = time.time()
 
+        from hpbandster_tpu.parallel.mesh import is_multiprocess_mesh
+
+        multiprocess = is_multiprocess_mesh(self.mesh)
         chunk = len(plans) if chunk_brackets is None else max(int(chunk_brackets), 1)
         done = first
         while plans:
@@ -358,6 +361,22 @@ class FusedBOHB:
             args = (
                 (seed, self._warm_v, self._warm_l) if self._warm_l else (seed,)
             )
+            if multiprocess:
+                # DCN tier: host-local numpy args become GLOBAL replicated
+                # arrays (every rank holds identical values — the SPMD
+                # drivers run the same deterministic control flow), matching
+                # the sweep executable's replicated in_shardings
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                rep = NamedSharding(self.mesh, PartitionSpec())
+
+                def to_global(x):
+                    arr = np.asarray(x)
+                    return jax.make_array_from_callback(
+                        arr.shape, rep, lambda idx: arr[idx]
+                    )
+
+                args = jax.tree.map(to_global, args)
             with trace(profile_dir):
                 compiled, compile_s, cache_hit = self._sweep_compiled(
                     tuple(chunk_plans), args

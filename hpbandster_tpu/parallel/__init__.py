@@ -10,6 +10,7 @@ from hpbandster_tpu.parallel.mesh import (  # noqa: F401
     batch_sharding,
     config_mesh,
     config_model_mesh,
+    is_multiprocess_mesh,
 )
 from hpbandster_tpu.parallel.backends import VmapBackend  # noqa: F401
 from hpbandster_tpu.parallel.batched_executor import BatchedExecutor  # noqa: F401

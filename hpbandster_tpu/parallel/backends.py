@@ -72,12 +72,9 @@ class VmapBackend:
     @property
     def _multiprocess(self) -> bool:
         """True when the mesh spans more than one JAX process (DCN tier)."""
-        if self.mesh is None:
-            return False
-        return any(
-            d.process_index != jax.process_index()
-            for d in self.mesh.devices.flat
-        )
+        from hpbandster_tpu.parallel.mesh import is_multiprocess_mesh
+
+        return is_multiprocess_mesh(self.mesh)
 
     def _padded_size(self, n: int) -> int:
         size = self.min_pad

@@ -15,7 +15,27 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-__all__ = ["config_mesh", "config_model_mesh", "batch_sharding"]
+__all__ = [
+    "config_mesh",
+    "config_model_mesh",
+    "batch_sharding",
+    "is_multiprocess_mesh",
+]
+
+
+def is_multiprocess_mesh(mesh: Optional[Mesh]) -> bool:
+    """True when ``mesh`` spans more than one JAX process (the DCN tier).
+
+    The single definition of "is this a multi-host run" — VmapBackend's
+    output replication, the fused sweep's replicated in/out shardings, and
+    FusedBOHB's global-array argument assembly all branch on this, and they
+    must agree or ranks deadlock fetching shards homed on other processes.
+    """
+    if mesh is None:
+        return False
+    return any(
+        d.process_index != jax.process_index() for d in mesh.devices.flat
+    )
 
 
 def config_mesh(devices: Optional[Sequence] = None) -> Mesh:

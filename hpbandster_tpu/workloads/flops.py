@@ -148,7 +148,8 @@ def resnet_step_flops(cfg: ResNetConfig = ResNetConfig()) -> float:
 
 # ------------------------------------------------------------- aggregation
 def sweep_training_flops(result, step_flops: float,
-                         steps_per_budget_unit: float = 1.0) -> float:
+                         steps_per_budget_unit: float = 1.0,
+                         include_failed: bool = False) -> float:
     """Total model FLOPs a sweep's TRAINING work executed.
 
     Every run at budget ``b`` trains from scratch for
@@ -157,8 +158,15 @@ def sweep_training_flops(result, step_flops: float,
     sweep total is ``step_flops * sum(budgets) * steps_per_budget_unit``
     over all finished runs. The per-run evaluation forward (one pass over
     the validation split) is excluded — it is <1% of a budget>=3 run.
+
+    ``include_failed``: on the FUSED tier a crashed (NaN-loss) config's
+    training steps DID execute on device before being masked, so callers
+    measuring device throughput there must pass True or achieved FLOP/s
+    and MFU are understated. The host tiers' crashed runs may have aborted
+    mid-budget, so the default stays conservative (exclude).
     """
     total_units = sum(
-        r.budget for r in result.get_all_runs() if r.loss is not None
+        r.budget for r in result.get_all_runs()
+        if include_failed or r.loss is not None
     )
     return step_flops * steps_per_budget_unit * float(total_units)

@@ -84,6 +84,39 @@ def main() -> None:
         json.dump(runs, f)
     print(f"proc {proc_id}: OK ({len(runs)} runs)")
 
+    # ---- phase 2 (VERDICT r3 #6): the FLAGSHIP fused whole-sweep tier
+    # end-to-end across the pod — every rank compiles the same sweep over
+    # the pod-wide mesh (replicated in/out shardings, config-axis-sharded
+    # evaluation), and the replayed promotion records must be bit-identical
+    from hpbandster_tpu.optimizers import FusedBOHB
+
+    fopt = FusedBOHB(
+        configspace=branin_space(seed=1),
+        eval_fn=branin_from_vector,
+        run_id="dcn-fused",
+        min_budget=1,
+        max_budget=9,
+        eta=3,
+        seed=1,
+        mesh=mesh,
+        min_points_in_model=5,
+        result_logger=None,  # side effects would need the primary gate
+    )
+    # two run() calls: the second threads the first's observations in as
+    # warm data, so the warm-pytree argument path (global replicated arrays
+    # from host-local numpy on every rank) is exercised under DCN too
+    fopt.run(n_iterations=2)
+    fres = fopt.run(n_iterations=3)
+    fruns = sorted(
+        (list(r.config_id), float(r.budget), float(r.loss))
+        for r in fres.get_all_runs()
+        if r.loss is not None
+    )
+    assert len(fruns) > 0
+    with open(os.path.join(outdir, f"fused_runs_{proc_id}.json"), "w") as f:
+        json.dump(fruns, f)
+    print(f"proc {proc_id}: fused OK ({len(fruns)} runs)")
+
 
 if __name__ == "__main__":
     main()

@@ -1,0 +1,114 @@
+"""Run both pytest lanes and persist a machine-readable summary artifact.
+
+VERDICT r3 weak #2: "the suite is green" was self-reported each round —
+this tool makes the claim reproduce without trust. It runs the fast lane
+(default `-m "not slow"` from pytest.ini) and the slow lane (`-m slow`),
+captures each lane's pass/fail counts and wall-clock, and writes one JSON
+artifact (default ``TESTS_r04.json`` at the repo root) that the round
+commits alongside the code it certifies.
+
+Usage: python -m tests.record_suite [output_path]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: token-wise parse of pytest's final summary line — the line's token set
+#: varies freely ("3 warnings", "2 errors", "1 xfailed", ...), so a single
+#: rigid regex silently fails to match and would mislabel a green run;
+#: instead pick up every "<count> <label>" pair plus the "in <secs>s" tail
+_TOKEN = re.compile(r"(\d+) (failed|passed|skipped|deselected|errors?|"
+                    r"warnings?|xfailed|xpassed)\b")
+_SECS = re.compile(r"\bin ([0-9.]+)s\b")
+
+
+def _parse_summary(stdout: str):
+    for line in reversed(stdout.strip().splitlines()):
+        tokens = _TOKEN.findall(line)
+        if not tokens:
+            continue
+        counts = {label.rstrip("s"): int(n) for n, label in tokens}
+        secs = _SECS.search(line)
+        return counts, (float(secs.group(1)) if secs else None)
+    return None, None
+
+
+def run_lane(name: str, marker_args: list) -> dict:
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "-q", *marker_args],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    wall = time.time() - t0
+    tail = "\n".join(proc.stdout.strip().splitlines()[-5:])
+    counts, secs = _parse_summary(proc.stdout)
+    lane = {
+        "lane": name,
+        "args": marker_args,
+        "returncode": proc.returncode,
+        "wall_s": round(wall, 1),
+        "summary_tail": tail,
+    }
+    if counts is not None:
+        lane.update(
+            failed=counts.get("failed", 0),
+            passed=counts.get("passed", 0),
+            skipped=counts.get("skipped", 0),
+            deselected=counts.get("deselected", 0),
+            errors=counts.get("error", 0),
+            pytest_reported_s=secs,
+        )
+    return lane
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        REPO, "TESTS_r04.json"
+    )
+    head = subprocess.run(
+        ["git", "rev-parse", "HEAD"], cwd=REPO, capture_output=True, text=True
+    ).stdout.strip()
+    dirty = bool(
+        subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=REPO, capture_output=True, text=True,
+        ).stdout.strip()
+    )
+    lanes = [
+        run_lane("fast", []),               # pytest.ini default: -m "not slow"
+        run_lane("slow", ["-m", "slow"]),
+    ]
+    result = {
+        "commit": head,
+        "worktree_dirty_when_run": dirty,
+        "python": platform.python_version(),
+        "backend": "cpu (8-device virtual mesh; tests/conftest.py)",
+        "lanes": lanes,
+        "green": all(
+            lane["returncode"] == 0 and lane.get("failed", 1) == 0
+            for lane in lanes
+        ),
+    }
+    with open(out_path, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps({k: result[k] for k in ("commit", "green")}))
+    for lane in lanes:
+        print(
+            f"{lane['lane']}: rc={lane['returncode']} "
+            f"passed={lane.get('passed')} failed={lane.get('failed')} "
+            f"({lane['wall_s']}s)"
+        )
+    return 0 if result["green"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -209,6 +209,46 @@ class TestFusedCheckpoint:
             other.load_checkpoint(path)
         assert not other.iterations
 
+    def test_cross_class_restore_rejected(self, tmp_path):
+        # a FusedH2BO checkpoint must NOT restore into a plain FusedBOHB:
+        # opt.config is identical across the two (promotion_rank_fn is not
+        # a knob), so without the class guard the remaining brackets would
+        # silently switch from LC-extrapolated to raw-loss promotion
+        # (ADVICE r3)
+        from hpbandster_tpu.optimizers import FusedH2BO
+
+        path = str(tmp_path / "h2bo.pkl")
+        opt = FusedH2BO(
+            configspace=branin_space(seed=7), eval_fn=branin_from_vector,
+            run_id="fused-ckpt", min_budget=1, max_budget=9, eta=3, seed=7,
+            min_points_in_model=5,
+        )
+        opt.run(n_iterations=1, checkpoint_path=path)
+        opt.shutdown()
+        other = make_fused()
+        with pytest.raises(ValueError, match="FusedH2BO"):
+            other.load_checkpoint(path)
+        assert not other.iterations
+
+    def test_pallas_knob_mismatch_rejected(self, tmp_path):
+        # the scorer backend is pinned too: Pallas and XLA scorers are
+        # numerically equivalent by test, but resume-bitwise-equality is
+        # the documented guarantee, so the knob must match (ADVICE r3)
+        from hpbandster_tpu.optimizers import FusedBOHB
+
+        path = str(tmp_path / "fused.pkl")
+        opt = make_fused()
+        opt.run(n_iterations=1, checkpoint_path=path)
+        opt.shutdown()
+        other = FusedBOHB(
+            configspace=branin_space(seed=7), eval_fn=branin_from_vector,
+            run_id="fused-ckpt", min_budget=1, max_budget=9, eta=3, seed=7,
+            min_points_in_model=5, use_pallas=not opt.use_pallas,
+        )
+        with pytest.raises(ValueError, match="use_pallas"):
+            other.load_checkpoint(path)
+        assert not other.iterations
+
     def test_host_checkpoint_rejected_by_fused_loader(self, tmp_path):
         path = str(tmp_path / "host.pkl")
         host = make_bohb(seed=6)

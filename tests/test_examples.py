@@ -109,3 +109,11 @@ def test_example_9_multihost_batched_workers():
     )
     assert "batched workers" in out
     assert "incumbent loss" in out
+
+
+@pytest.mark.slow
+def test_example_10_multihost_fused_spmd():
+    # self-launch demo: 2 jax.distributed ranks, 4-device pod, fused sweep,
+    # asserts cross-rank run-record agreement internally
+    out = run_example("example_10_multihost_fused_spmd.py", timeout=600)
+    assert "SPMD OK" in out

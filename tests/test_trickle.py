@@ -98,10 +98,12 @@ class TestTrickleRefits:
         assert advanced_within_budget >= 3, events
 
 
-def measure(seeds=range(8), n_iterations=4):
+def measure(seeds=range(16), n_iterations=4):
     """Trickle (sequential host pool) vs stage-chunked (batched executor)
     sample efficiency at identical seeds/budgets; prints the
-    docs/best_practices.md table."""
+    docs/best_practices.md table (16 seeds — the default here MUST match
+    the table's stated seed count so `python -m tests.test_trickle`
+    reproduces the committed numbers; ADVICE r3)."""
     from hpbandster_tpu.core.nameserver import NameServer
     from hpbandster_tpu.core.worker import Worker
     from hpbandster_tpu.optimizers import BOHB
