@@ -31,6 +31,14 @@ __all__ = [
 ]
 
 
+def _clamp(value, lower, upper):
+    """Scalar clamp. Replaces np.clip on the hot per-config codec paths —
+    numpy's scalar clip routes through array coercion and dominated
+    fused-replay profiles. NaN propagates (value is max's first arg),
+    matching np.clip."""
+    return min(max(value, lower), upper)
+
+
 class Hyperparameter:
     """Base class. One hyperparameter == one dimension of the config vector."""
 
@@ -114,7 +122,7 @@ class UniformFloatHyperparameter(Hyperparameter):
     def _quantize(self, value: float) -> float:
         if self.q is None:
             return value
-        return float(np.clip(round(value / self.q) * self.q, self.lower, self.upper))
+        return float(_clamp(round(value / self.q) * self.q, self.lower, self.upper))
 
     def to_unit(self, value: Any) -> float:
         v = float(value)
@@ -124,10 +132,10 @@ class UniformFloatHyperparameter(Hyperparameter):
             )
         else:
             u = (v - self.lower) / (self.upper - self.lower)
-        return float(np.clip(u, 0.0, 1.0))
+        return float(_clamp(u, 0.0, 1.0))
 
     def from_unit(self, u: float) -> float:
-        u = float(np.clip(u, 0.0, 1.0))
+        u = float(_clamp(u, 0.0, 1.0))
         if self.log:
             v = math.exp(
                 math.log(self.lower)
@@ -135,7 +143,7 @@ class UniformFloatHyperparameter(Hyperparameter):
             )
         else:
             v = self.lower + u * (self.upper - self.lower)
-        return self._quantize(float(np.clip(v, self.lower, self.upper)))
+        return self._quantize(float(_clamp(v, self.lower, self.upper)))
 
     def sample_unit(self, rng: np.random.Generator) -> float:
         return float(rng.uniform())
@@ -198,18 +206,18 @@ class UniformIntegerHyperparameter(Hyperparameter):
                 (math.log(v) - math.log(max(self.lower, 1) * 0.5001))
                 / (math.log(self.upper + 0.4999) - math.log(max(self.lower, 1) * 0.5001))
             )
-            return float(np.clip(u, 0.0, 1.0))
-        return float(np.clip((v - self.lower + 0.5) / self._n, 0.0, 1.0))
+            return float(_clamp(u, 0.0, 1.0))
+        return float(_clamp((v - self.lower + 0.5) / self._n, 0.0, 1.0))
 
     def from_unit(self, u: float) -> int:
-        u = float(np.clip(u, 0.0, 1.0))
+        u = float(_clamp(u, 0.0, 1.0))
         if self.log:
             lo = (self.lower - 0.4999) if self.lower > 1 else max(self.lower, 1) * 0.5001
             hi = self.upper + 0.4999
             v = math.exp(math.log(lo) + u * (math.log(hi) - math.log(lo)))
         else:
             v = self.lower - 0.5 + u * self._n
-        return int(np.clip(int(round(v)), self.lower, self.upper))
+        return int(_clamp(int(round(v)), self.lower, self.upper))
 
     def sample_unit(self, rng: np.random.Generator) -> float:
         return float(rng.uniform())
@@ -264,7 +272,7 @@ class CategoricalHyperparameter(Hyperparameter):
         return float(self.index(value))
 
     def from_unit(self, u: float) -> Any:
-        idx = int(np.clip(int(round(float(u))), 0, self.num_choices - 1))
+        idx = int(_clamp(int(round(float(u))), 0, self.num_choices - 1))
         return self.choices[idx]
 
     def sample_unit(self, rng: np.random.Generator) -> float:
@@ -301,7 +309,7 @@ class OrdinalHyperparameter(Hyperparameter):
         return float(self.index(value))
 
     def from_unit(self, u: float) -> Any:
-        idx = int(np.clip(int(round(float(u))), 0, self.num_choices - 1))
+        idx = int(_clamp(int(round(float(u))), 0, self.num_choices - 1))
         return self.sequence[idx]
 
     def sample_unit(self, rng: np.random.Generator) -> float:
