@@ -135,15 +135,23 @@ class Master:
                 self.logger.debug("queue sizes adjusted to %s", self.job_queue_sizes)
             self.thread_cond.notify_all()
 
-    def job_callback(self, job: Job) -> None:
+    def job_callback(self, job: Job, update_model: bool = True) -> None:
         """Result ingestion: log -> iteration bookkeeping -> model update ->
-        stage advancement -> wake the run loop (reference §3.3)."""
+        stage advancement -> wake the run loop (reference §3.3).
+
+        ``update_model=False`` records the observation but defers the model
+        refit (burst deliveries from batched executors: N results of one
+        wave arrive before any proposal can happen, so N-1 eager refits
+        would be computed and immediately discarded). The host-pool tier
+        always passes True — its trickle semantics are pinned by
+        ``tests/test_trickle.py``.
+        """
         with self.thread_cond:
             self.num_running_jobs -= 1
             if self.result_logger is not None:
                 self.result_logger(job)
             self.iterations[job.id[0]].register_result(job)
-            self.config_generator.new_result(job)
+            self.config_generator.new_result(job, update_model=update_model)
             self.iterations[job.id[0]].process_results()
             if self.num_running_jobs <= self.job_queue_sizes[0]:
                 self.thread_cond.notify_all()

@@ -114,13 +114,31 @@ class BOHBKDE(base_config_generator):
         #: budget -> device-resident copy; invalidated on refit so each model
         #: version uploads through the (possibly high-latency) link only once
         self._device_kdes: Dict[float, Tuple[KDE, KDE]] = {}
+        #: budgets with recorded-but-unfitted observations: a burst delivery
+        #: (``new_result(update_model=False)``, the batched executor's wave
+        #: path) defers the refit to the next proposal, which then fits over
+        #: exactly the observations an eager per-result refit would have
+        #: seen — minus the N-1 discarded intermediate fits. On CONDITIONAL
+        #: spaces the fit's NaN imputation draws from ``self.rng``, so
+        #: skipping intermediate fits shifts the RNG stream relative to the
+        #: eager path: each tier stays fully deterministic in its seed, but
+        #: burst and trickle tiers are distinct RNG histories, not bitwise
+        #: twins (they never were: the tiers already propose in different
+        #: order)
+        self._dirty_budgets: set = set()
 
     # -------------------------------------------------------------- plumbing
     def _next_key(self, n: int = 1):
         self.key, *sub = jax.random.split(self.key, n + 1)
         return sub[0] if n == 1 else jnp.stack(sub)
 
+    def _refit_dirty(self) -> None:
+        for budget in sorted(self._dirty_budgets):
+            self._fit_kde_pair(budget)
+        self._dirty_budgets.clear()
+
     def largest_budget_with_model(self) -> Optional[float]:
+        self._refit_dirty()
         if not self.kde_models:
             return None
         return max(self.kde_models.keys())
@@ -245,6 +263,7 @@ class BOHBKDE(base_config_generator):
         self.key = jax.random.wrap_key_data(jnp.asarray(state["jax_key"]))
         self.kde_models.clear()
         self._device_kdes.clear()
+        self._dirty_budgets.clear()
         for budget in self.configs:
             self._fit_kde_pair(budget)
 
@@ -261,6 +280,10 @@ class BOHBKDE(base_config_generator):
         self.losses.setdefault(budget, []).append(loss)
         if update_model:
             self._fit_kde_pair(budget)
+            self._dirty_budgets.discard(budget)
+        else:
+            # burst/warm-start path: record now, fit at the next proposal
+            self._dirty_budgets.add(budget)
 
     def get_config(self, budget: float) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         best_budget = self.largest_budget_with_model()

@@ -100,7 +100,13 @@ class BatchedExecutor:
                 f"non-finite loss {loss!r} at budget {job.kwargs['budget']}"
             )
         self.total_evaluated += 1
-        self._new_result_callback(job)
+        # burst delivery: all of a flush's results land before the Master
+        # can propose again (flush runs synchronously inside Master.run), so
+        # the model records each observation now and refits ONCE at the next
+        # proposal instead of after every result — the proposal fits over
+        # the same observations, skipping the N-1 fits nothing could read
+        # (see BOHBKDE._dirty_budgets for the conditional-space RNG caveat)
+        self._new_result_callback(job, update_model=False)
 
     # ---------------------------------------------------------- fused path
     def _try_fuse(self, jobs: List[Job]) -> Optional[List[Job]]:
