@@ -202,9 +202,11 @@ class BOHBKDE(base_config_generator):
         self._device_kdes.pop(budget, None)
 
     def _make_kde(self, data: np.ndarray) -> KDE:
-        """Fit happens host-side in numpy (no device dispatch per result —
-        the refit runs after every single job, reference-style); the arrays
-        transfer once per *stage* when the propose kernel consumes them."""
+        """Fit happens host-side in numpy (no device dispatch per fit); the
+        arrays transfer once per *stage* when the propose kernel consumes
+        them. Fit TIMING depends on the tier: the host pool refits after
+        every single job (reference trickle), batched executors defer to
+        the next proposal via ``_dirty_budgets``."""
         n, d = data.shape
         # generous minimum capacity: observation growth then changes the
         # compiled shape only every doubling past 64
