@@ -653,10 +653,11 @@ def write_baseline(result, path="BASELINE.md", source=None):
     t = d["tiers"]
 
     def row(name, s):
-        if not (isinstance(s, dict) and "median" in s and "iqr" in s):
+        try:
+            lo, hi = s["iqr"]
+            return f"| {name} | {s['median']} | [{lo}, {hi}] |"
+        except (TypeError, KeyError, ValueError):
             return f"| {name} | not measured | — |"
-        lo, hi = s["iqr"]
-        return f"| {name} | {s['median']} | [{lo}, {hi}] |"
 
     def render(x, *variants, fallback):
         """First formatter whose keys all exist wins; guard and format
@@ -806,6 +807,14 @@ def main():
         parsed = data.get("parsed", data) if isinstance(data, dict) else None
         if not parsed or parsed.get("value") is None:
             print("bench: %s has no usable parsed result" % src,
+                  file=sys.stderr)
+            sys.exit(1)
+        # same refusal the in-run --write-baseline applies: the committed
+        # 'Measured' table must never come from a smoke or degraded run
+        if parsed.get("smoke") or parsed.get("error"):
+            print("bench: refusing to regenerate BASELINE.md from a smoke/"
+                  "degraded artifact (%s: smoke=%s, error=%s)"
+                  % (src, parsed.get("smoke"), parsed.get("error")),
                   file=sys.stderr)
             sys.exit(1)
         write_baseline(parsed, source=src)
