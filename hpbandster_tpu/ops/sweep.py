@@ -557,6 +557,56 @@ def _fit_kde_pair_device(
     return mk(good), mk(bad)
 
 
+def _fit_kde_pair_dynamic(
+    vecs: jax.Array,
+    losses: jax.Array,
+    count: jax.Array,
+    n_good: jax.Array,
+    n_bad: jax.Array,
+    cards: jax.Array,
+    min_bandwidth: float,
+    impute_key: Optional[jax.Array] = None,
+) -> Tuple[KDE, KDE]:
+    """Traced-count twin of :func:`_fit_kde_pair_device`.
+
+    ``vecs``/``losses`` are FULL capacity buffers (``f32[C, d]`` /
+    ``f32[C]``, empty slots carrying ``+inf`` loss); ``count`` / ``n_good``
+    / ``n_bad`` are traced i32 scalars. Split membership becomes a rank
+    mask over the loss-sorted buffer instead of a static slice — every KDE
+    primitive downstream (bandwidths, log-pdf, candidate sampling, the
+    Pallas scorer) is already mask-weighted, so the fitted model is the
+    same; only observation COUNTS stop being burned into the compiled
+    program (the point: chunked/warm-started sweeps reuse one executable
+    as observations accumulate, see ``make_fused_sweep_fn``).
+    """
+    cap = vecs.shape[0]
+    order = jnp.argsort(losses, stable=True)  # +inf pads sort last
+    sorted_v = vecs[order]
+    rank = jnp.arange(cap, dtype=jnp.int32)
+    good_mask = rank < n_good
+    bad_mask = (rank >= count - n_bad) & (rank < count)
+    if impute_key is not None:
+        # conditional spaces: donor-impute each split side exactly like the
+        # static path, with non-members NaN'd out so they neither donate
+        # nor constrain (their filled values are then masked from the fit)
+        kg, kb = jax.random.split(impute_key)
+        good_data = _impute_conditional_device(
+            kg, jnp.where(good_mask[:, None], sorted_v, jnp.nan), cards
+        )
+        bad_data = _impute_conditional_device(
+            kb, jnp.where(bad_mask[:, None], sorted_v, jnp.nan), cards
+        )
+    else:
+        good_data = bad_data = sorted_v
+
+    def mk(data: jax.Array, mask: jax.Array) -> KDE:
+        mask = mask.astype(jnp.float32)
+        bw = normal_reference_bandwidths(data, mask, cards, min_bandwidth)
+        return KDE(data, mask, bw)
+
+    return mk(good_data, good_mask), mk(bad_data, bad_mask)
+
+
 def make_fused_sweep_fn(
     eval_fn: Callable[[jax.Array, float], jax.Array],
     plans: Sequence[BracketPlan],
@@ -578,6 +628,8 @@ def make_fused_sweep_fn(
     forbidden_fn: Optional[Callable] = None,
     fallback_vector: Optional[np.ndarray] = None,
     max_forbidden_retries: int = 8,
+    dynamic_counts: bool = False,
+    capacities: Optional[dict] = None,
 ) -> Callable[..., List[SweepBracketOutput]]:
     """Trace + jit the whole sweep; returns ``fn(seed[, warm_v, warm_l])``.
 
@@ -599,6 +651,20 @@ def make_fused_sweep_fn(
     to ``max_forbidden_retries`` times, and any row still forbidden after
     that is replaced by ``fallback_vector`` (a host-verified valid
     configuration) — bounded work, static shapes, no host round-trip.
+
+    ``dynamic_counts=True`` keeps observation COUNTS out of the compiled
+    program: the jitted fn takes ``(seed, warm_v, warm_l, warm_n)`` where
+    each ``warm_v[b]`` / ``warm_l[b]`` is a FULL-capacity buffer and
+    ``warm_n[b]`` a traced i32 count. Model gating, good/bad split sizes
+    and the largest-trained-budget selection all become traced arithmetic
+    (:func:`_fit_kde_pair_dynamic`), so a chunked or warm-started sweep
+    reuses ONE executable as observations accumulate instead of
+    recompiling at every chunk boundary — the static path burns every
+    count into the trace and a K-chunk run costs K compiles. Proposal math
+    then runs over full capacity buffers (mask-weighted), a constant-factor
+    cost the chunked tier accepts for compile reuse. ``capacities``
+    (budget -> slots, must cover warm + every plan's additions) pins the
+    buffer shapes so all chunks of one run agree on them.
     """
     d = int(codec.kind.shape[0])
     if forbidden_fn is not None and fallback_vector is None:
@@ -612,6 +678,14 @@ def make_fused_sweep_fn(
     for plan in plans:
         for k, b in zip(plan.num_configs, plan.budgets):
             caps[float(b)] = caps.get(float(b), 0) + int(k)
+    if capacities is not None:
+        for b, need in caps.items():
+            if capacities.get(float(b), 0) < need:
+                raise ValueError(
+                    f"capacities[{b}]={capacities.get(float(b))} cannot hold "
+                    f"the {need} observations this sweep accumulates there"
+                )
+        caps = {float(b): int(n) for b, n in capacities.items()}
 
     vartypes_dev = jnp.asarray(codec.vartypes)
     cards_dev = jnp.asarray(codec.cards)
@@ -626,21 +700,116 @@ def make_fused_sweep_fn(
             return None
         return n_good, n_bad
 
+    def _propose_model_vecs(good: KDE, bad: KDE, k_prop: jax.Array, n0: int):
+        if use_pallas:
+            from hpbandster_tpu.ops.pallas_kde import pallas_propose_batch
+
+            return pallas_propose_batch(
+                k_prop, good, bad, vartypes_dev, cards_dev, n0,
+                num_samples, bandwidth_factor, min_bandwidth,
+                pallas_interpret,
+            )
+        keys = jax.random.split(k_prop, n0)
+        return jax.vmap(
+            lambda k: propose(
+                k, good, bad, vartypes_dev, cards_dev,
+                num_samples, bandwidth_factor, min_bandwidth,
+            )[0]
+        )(keys)
+
+    # dynamic-count machinery: the gate arithmetic is the i32-traced twin of
+    # trained_split (same integer formulas, so the model opens at exactly
+    # the same observation counts as the static path and the host model)
+    capmax = max(caps.values(), default=0)
+    any_trainable = any(trained_split(c) is not None for c in caps.values())
+
+    def dynamic_gate(cnt: jax.Array):
+        n_good = jnp.maximum(min_pts, (top_n_percent * cnt) // 100)
+        n_bad = jnp.maximum(min_pts, ((100 - top_n_percent) * cnt) // 100)
+        has = (cnt >= min_pts + 2) & (n_good > d) & (n_bad > d)
+        return has, n_good, n_bad
+
+    def dynamic_proposals(
+        obs_v, obs_l, counts, rand_vecs, k_prop, k_frac, k_fit, n0
+    ):
+        """Largest-trained-budget selection + fit + proposal, all traced.
+
+        Budget priority is a static descending unroll; the selected
+        budget's buffer is widened to ``capmax`` so one fit serves
+        whichever budget wins. When no budget's gate is open the fit runs
+        on empty buffers (harmless, NaN-free) and ``mb_mask`` discards
+        every model pick — matching the static path's all-random bracket.
+        """
+        sel_v = jnp.zeros((capmax, d), jnp.float32)
+        sel_l = jnp.full((capmax,), jnp.inf, jnp.float32)
+        sel_n = jnp.zeros((), jnp.int32)
+        any_model = jnp.zeros((), bool)
+        for b in sorted(caps, reverse=True):
+            has, _, _ = dynamic_gate(counts[b])
+            take = has & ~any_model
+            pad = capmax - caps[b]
+            pv = jnp.pad(obs_v[b], ((0, pad), (0, 0)))
+            pl = jnp.pad(obs_l[b], (0, pad), constant_values=jnp.inf)
+            sel_v = jnp.where(take, pv, sel_v)
+            sel_l = jnp.where(take, pl, sel_l)
+            sel_n = jnp.where(take, counts[b], sel_n)
+            any_model = any_model | has
+        _, n_good, n_bad = dynamic_gate(sel_n)
+        good, bad = _fit_kde_pair_dynamic(
+            sel_v, sel_l, sel_n, n_good, n_bad, cards_dev, min_bandwidth,
+            impute_key=k_fit if active_mask_fn is not None else None,
+        )
+        model_vecs = _propose_model_vecs(good, bad, k_prop, n0)
+        mb_mask = any_model & (
+            jax.random.uniform(k_frac, (n0,)) >= random_fraction
+        )
+        proposals = jnp.where(mb_mask[:, None], model_vecs, rand_vecs)
+        return proposals, mb_mask
+
     def sweep(
-        seed: jax.Array, warm_v=None, warm_l=None
+        seed: jax.Array, warm_v=None, warm_l=None, warm_n=None
     ) -> List[SweepBracketOutput]:
         key = jax.random.key(seed)
-        obs_v = {b: jnp.zeros((cap, d), jnp.float32) for b, cap in caps.items()}
-        obs_l = {b: jnp.zeros(cap, jnp.float32) for b, cap in caps.items()}
-        counts = {b: 0 for b in caps}  # python ints: static
-        for b, n in warm_counts.items():
-            obs_v[b] = obs_v[b].at[:n].set(warm_v[b].astype(jnp.float32))
-            obs_l[b] = obs_l[b].at[:n].set(
-                jnp.where(jnp.isnan(warm_l[b]), jnp.inf, warm_l[b]).astype(
-                    jnp.float32
+        if dynamic_counts:
+            # full-capacity buffers in, traced counts; pad slots pinned to
+            # (0-vector, +inf loss) regardless of what the caller sent.
+            # Each budget's additions over the whole schedule are static,
+            # so clamping the traced warm count to (capacity - additions)
+            # keeps every later append inside the buffer — an oversized
+            # caller count truncates its newest warm rows deterministically
+            # instead of silently clobbering fresh observations through
+            # dynamic_update_slice's start-index clamping.
+            additions = {b: 0 for b in caps}
+            for plan in plans:
+                for k, b in zip(plan.num_configs, plan.budgets):
+                    additions[float(b)] += int(k)
+            obs_v, obs_l, counts = {}, {}, {}
+            for b, cap in caps.items():
+                n_b = jnp.minimum(
+                    jnp.asarray(warm_n[b], jnp.int32), cap - additions[b]
                 )
-            )
-            counts[b] = n
+                live = jnp.arange(cap, dtype=jnp.int32) < n_b
+                v = jnp.asarray(warm_v[b], jnp.float32)
+                l = jnp.asarray(warm_l[b], jnp.float32)
+                obs_v[b] = jnp.where(live[:, None], v, 0.0)
+                obs_l[b] = jnp.where(
+                    live & ~jnp.isnan(l), l, jnp.inf
+                )
+                counts[b] = n_b
+        else:
+            obs_v = {
+                b: jnp.zeros((cap, d), jnp.float32) for b, cap in caps.items()
+            }
+            obs_l = {b: jnp.zeros(cap, jnp.float32) for b, cap in caps.items()}
+            counts = {b: 0 for b in caps}  # python ints: static
+            for b, n in warm_counts.items():
+                obs_v[b] = obs_v[b].at[:n].set(warm_v[b].astype(jnp.float32))
+                obs_l[b] = obs_l[b].at[:n].set(
+                    jnp.where(jnp.isnan(warm_l[b]), jnp.inf, warm_l[b]).astype(
+                        jnp.float32
+                    )
+                )
+                counts[b] = n
         outputs: List[SweepBracketOutput] = []
 
         for b_i, plan in enumerate(plans):
@@ -650,41 +819,43 @@ def make_fused_sweep_fn(
             )
             rand_vecs = random_unit(codec, k_rand, n0)
 
-            model_budget = None
-            for b in sorted(caps, reverse=True):
-                if trained_split(counts[b]) is not None:
-                    model_budget = b
-                    break
-
-            if model_budget is None:
-                proposals = rand_vecs
-                mb_mask = jnp.zeros(n0, bool)
-            else:
-                n = counts[model_budget]
-                n_good, n_bad = trained_split(n)
-                good, bad = _fit_kde_pair_device(
-                    obs_v[model_budget][:n], obs_l[model_budget][:n],
-                    n_good, n_bad, cards_dev, min_bandwidth,
-                    impute_key=k_fit if active_mask_fn is not None else None,
-                )
-                if use_pallas:
-                    from hpbandster_tpu.ops.pallas_kde import pallas_propose_batch
-
-                    model_vecs = pallas_propose_batch(
-                        k_prop, good, bad, vartypes_dev, cards_dev, n0,
-                        num_samples, bandwidth_factor, min_bandwidth,
-                        pallas_interpret,
-                    )
+            if dynamic_counts:
+                if not any_trainable:
+                    # no budget's gate can open even at full capacity
+                    # (FusedHyperBand/RandomSearch) — skip tracing the
+                    # model math entirely
+                    proposals = rand_vecs
+                    mb_mask = jnp.zeros(n0, bool)
                 else:
-                    keys = jax.random.split(k_prop, n0)
-                    model_vecs = jax.vmap(
-                        lambda k: propose(
-                            k, good, bad, vartypes_dev, cards_dev,
-                            num_samples, bandwidth_factor, min_bandwidth,
-                        )[0]
-                    )(keys)
-                mb_mask = jax.random.uniform(k_frac, (n0,)) >= random_fraction
-                proposals = jnp.where(mb_mask[:, None], model_vecs, rand_vecs)
+                    proposals, mb_mask = dynamic_proposals(
+                        obs_v, obs_l, counts, rand_vecs, k_prop, k_frac,
+                        k_fit, n0,
+                    )
+            else:
+                model_budget = None
+                for b in sorted(caps, reverse=True):
+                    if trained_split(counts[b]) is not None:
+                        model_budget = b
+                        break
+
+                if model_budget is None:
+                    proposals = rand_vecs
+                    mb_mask = jnp.zeros(n0, bool)
+                else:
+                    n = counts[model_budget]
+                    n_good, n_bad = trained_split(n)
+                    good, bad = _fit_kde_pair_device(
+                        obs_v[model_budget][:n], obs_l[model_budget][:n],
+                        n_good, n_bad, cards_dev, min_bandwidth,
+                        impute_key=k_fit if active_mask_fn is not None else None,
+                    )
+                    model_vecs = _propose_model_vecs(good, bad, k_prop, n0)
+                    mb_mask = (
+                        jax.random.uniform(k_frac, (n0,)) >= random_fraction
+                    )
+                    proposals = jnp.where(
+                        mb_mask[:, None], model_vecs, rand_vecs
+                    )
 
             vectors = quantize_unit(codec, proposals)
 
@@ -752,10 +923,17 @@ def make_fused_sweep_fn(
             ):
                 b = float(budget)
                 c = counts[b]
-                obs_v[b] = obs_v[b].at[c:c + k_s].set(out_vectors[idx_s])
-                obs_l[b] = obs_l[b].at[c:c + k_s].set(
-                    jnp.where(jnp.isnan(losses_s), jnp.inf, losses_s)
-                )
+                upd_l = jnp.where(jnp.isnan(losses_s), jnp.inf, losses_s)
+                if dynamic_counts:
+                    obs_v[b] = jax.lax.dynamic_update_slice_in_dim(
+                        obs_v[b], out_vectors[idx_s], c, 0
+                    )
+                    obs_l[b] = jax.lax.dynamic_update_slice_in_dim(
+                        obs_l[b], upd_l, c, 0
+                    )
+                else:
+                    obs_v[b] = obs_v[b].at[c:c + k_s].set(out_vectors[idx_s])
+                    obs_l[b] = obs_l[b].at[c:c + k_s].set(upd_l)
                 counts[b] = c + k_s
 
             idx_packed, loss_packed = _pack_stages(stages)

@@ -187,6 +187,38 @@ class TestPdfAndScoreOracle:
             cards=jnp.asarray(CARDS),
             min_bandwidth=1e-3,
         )
+        self._assert_fit_goldens(good, bad)
+
+    @pytest.mark.parametrize("capacity", [5, 8, 16])
+    @pytest.mark.parametrize("perm", [[0, 1, 2, 3, 4], [3, 0, 4, 2, 1]])
+    def test_dynamic_count_fit_matches_goldens(self, perm, capacity):
+        # the dynamic-count tier (traced counts over full-capacity buffers,
+        # ops.sweep._fit_kde_pair_dynamic) must reproduce the SAME
+        # statsmodels goldens at every capacity: the rank masks and the
+        # mask-weighted bandwidth/pdf math may not let padding leak into
+        # the fitted model
+        from hpbandster_tpu.ops.sweep import _fit_kde_pair_dynamic
+
+        perm = np.asarray(perm)
+        losses = np.asarray([0.1, 0.2, 0.3, 0.8, 0.9], np.float32)
+        vecs = np.zeros((capacity, DATA.shape[1]), np.float32)
+        padded_losses = np.full(capacity, np.inf, np.float32)
+        vecs[:5] = DATA[perm]
+        padded_losses[:5] = losses[perm]
+        good, bad = _fit_kde_pair_dynamic(
+            jnp.asarray(vecs),
+            jnp.asarray(padded_losses),
+            count=jnp.int32(5),
+            n_good=jnp.int32(3),
+            n_bad=jnp.int32(2),
+            cards=jnp.asarray(CARDS),
+            min_bandwidth=1e-3,
+        )
+        assert int(np.asarray(good.mask).sum()) == 3
+        assert int(np.asarray(bad.mask).sum()) == 2
+        self._assert_fit_goldens(good, bad)
+
+    def _assert_fit_goldens(self, good, bad):
         np.testing.assert_allclose(np.asarray(good.bw), GOLD_BW_GOOD, rtol=2e-6)
         np.testing.assert_allclose(np.asarray(bad.bw), GOLD_BW_BAD, rtol=2e-6)
         vt, cd = jnp.asarray(VARTYPES), jnp.asarray(CARDS)

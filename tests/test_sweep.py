@@ -859,3 +859,132 @@ class TestFusedSweep:
 
         assert best(42) == best(42)
         assert best(42) != best(43)
+
+
+class TestDynamicCountSweep:
+    """The dynamic-count fused tier (ops.sweep dynamic_counts=True): chunked
+    runs reuse one executable until a capacity bucket doubles, where the
+    static tier burns every chunk's observation counts into a fresh trace
+    and pays one compile per chunk."""
+
+    def _mk(self, seed=11, **kw):
+        from hpbandster_tpu.optimizers import FusedBOHB
+
+        return FusedBOHB(
+            configspace=branin_space(seed=3), eval_fn=branin_from_vector,
+            run_id="dyn", min_budget=1, max_budget=9, eta=3, seed=seed, **kw
+        )
+
+    def test_chunked_run_compiles_log_many_not_per_chunk(self):
+        opt = self._mk()
+        res = opt.run(n_iterations=9, chunk_brackets=3)
+        opt.shutdown()
+        assert len(opt.run_stats) == 3
+        assert all(s["dynamic_counts"] for s in opt.run_stats)
+        fresh = [s for s in opt.run_stats if not s["compile_cache_hit"]]
+        # 3 chunks: chunk 2 grows the budget-1.0 bucket past chunk 1's, so
+        # at most 2 fresh compiles are acceptable — the static tier pays 3
+        assert len(fresh) <= 2
+        # the sweep itself is a full, well-formed BOHB run
+        plans = hyperband_schedule(9, 1, 9, 3)
+        assert len(res.get_all_runs()) == sum(sum(p.num_configs) for p in plans)
+        assert res.get_incumbent_id() is not None
+
+    def test_forced_dynamic_matches_sh_arithmetic_and_is_deterministic(self):
+        def run_once():
+            opt = self._mk(seed=21)
+            res = opt.run(n_iterations=4, dynamic_counts=True)
+            opt.shutdown()
+            return sorted(
+                (r.config_id, r.budget, r.loss) for r in res.get_all_runs()
+            )
+
+        a, b = run_once(), run_once()
+        assert a == b
+        plans = hyperband_schedule(4, 1, 9, 3)
+        assert len(a) == sum(sum(p.num_configs) for p in plans)
+
+    def test_dynamic_model_gate_opens_like_static(self):
+        # same observation-count gate arithmetic as the static tier and the
+        # host model: with enough observations, later brackets must contain
+        # model-based picks on BOTH tiers
+        def model_picks(dynamic):
+            opt = self._mk(seed=31, min_points_in_model=5)
+            res = opt.run(n_iterations=6, dynamic_counts=dynamic)
+            opt.shutdown()
+            id2c = res.get_id2config_mapping()
+            return sum(
+                1 for e in id2c.values()
+                if e["config_info"].get("model_based_pick")
+            )
+
+        n_dyn, n_static = model_picks(True), model_picks(False)
+        assert n_dyn > 0 and n_static > 0
+
+    def test_dynamic_never_model_shortcut_for_pure_random(self):
+        # FusedHyperBand's unreachable gate must keep the dynamic tier
+        # all-random (and not trace dead model math into the program)
+        from hpbandster_tpu.optimizers import FusedHyperBand
+
+        opt = FusedHyperBand(
+            configspace=branin_space(seed=3), eval_fn=branin_from_vector,
+            run_id="dyn-hb", min_budget=1, max_budget=9, eta=3, seed=41,
+        )
+        res = opt.run(n_iterations=4, chunk_brackets=2)
+        opt.shutdown()
+        assert all(s["dynamic_counts"] for s in opt.run_stats)
+        id2c = res.get_id2config_mapping()
+        assert not any(
+            e["config_info"].get("model_based_pick") for e in id2c.values()
+        )
+
+    def test_dynamic_with_pallas_scorer_interpreted(self):
+        # on a real TPU chunked FusedBOHB runs dynamic counts WITH the
+        # Pallas scorer (default-on there) — trace that combination via the
+        # interpreter: the kernel is mask-weighted, so capacity-padded KDEs
+        # must score like exact ones
+        opt = self._mk(seed=61, use_pallas=True, min_points_in_model=5)
+        assert opt.pallas_interpret
+        res = opt.run(n_iterations=4, chunk_brackets=2)
+        opt.shutdown()
+        assert all(s["dynamic_counts"] for s in opt.run_stats)
+        runs = res.get_all_runs()
+        assert len(runs) > 0
+        assert all(np.isfinite(r.loss) for r in runs if r.loss is not None)
+        id2c = res.get_id2config_mapping()
+        assert any(
+            e["config_info"].get("model_based_pick") for e in id2c.values()
+        ), "dynamic pallas-scored sweep produced no model-based picks"
+
+    def test_dynamic_conditional_space_respects_activity(self):
+        # conditional spaces ride the dynamic tier too: the rank-masked fit
+        # imputes inactive dims (the masked donor path) and every decoded
+        # config still carries exactly the host activity pattern
+        from hpbandster_tpu.optimizers import FusedBOHB
+
+        cs = ConfigurationSpace(seed=0)
+        x = UniformFloatHyperparameter("x", -5.0, 10.0)
+        opt_hp = CategoricalHyperparameter("opt", ["sgd", "adam"])
+        mom = UniformFloatHyperparameter("momentum", 0.0, 0.99)
+        cs.add_hyperparameters([x, opt_hp, mom])
+        cs.add_condition(EqualsCondition(mom, opt_hp, "sgd"))
+
+        def eval_fn(vec, budget):
+            return vec[0] * vec[0] + 0.1 * vec[2] + 0.0 * budget
+
+        opt = FusedBOHB(
+            configspace=cs, eval_fn=eval_fn, run_id="dyn-cond",
+            min_budget=1, max_budget=9, eta=3, seed=51,
+            min_points_in_model=5,
+        )
+        res = opt.run(n_iterations=4, chunk_brackets=2)
+        opt.shutdown()
+        assert all(s["dynamic_counts"] for s in opt.run_stats)
+        id2c = res.get_id2config_mapping()
+        assert len(id2c) > 0
+        for entry in id2c.values():
+            cfg = entry["config"]
+            # host activity semantics hold exactly: momentum present iff
+            # the sgd arm is active, and the host codec round-trips
+            assert ("momentum" in cfg) == (cfg["opt"] == "sgd"), cfg
+            assert dict(cs.from_vector(cs.to_vector(cfg))) == cfg
