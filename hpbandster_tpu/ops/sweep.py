@@ -44,7 +44,19 @@ from hpbandster_tpu.ops.kde import KDE, normal_reference_bandwidths, propose
 
 __all__ = ["SpaceCodec", "build_space_codec", "quantize_unit", "random_unit",
            "compile_active_mask", "compile_forbidden_mask",
-           "make_fused_sweep_fn", "SweepBracketOutput"]
+           "make_fused_sweep_fn", "SweepBracketOutput", "plan_additions"]
+
+
+def plan_additions(plans: Sequence[BracketPlan]) -> dict:
+    """Per-budget observation counts a plan sequence appends — the ONE
+    definition shared by capacity seeding, the dynamic warm-count clamp,
+    and ``FusedBOHB``'s bucket sizing (they must agree or the three
+    silently drift)."""
+    out: dict = {}
+    for plan in plans:
+        for k, b in zip(plan.num_configs, plan.budgets):
+            out[float(b)] = out.get(float(b), 0) + int(k)
+    return out
 
 
 class SpaceCodec(NamedTuple):
@@ -674,10 +686,10 @@ def make_fused_sweep_fn(
     warm_counts = {float(b): int(n) for b, n in (warm_counts or {}).items() if n > 0}
 
     # static per-budget observation capacities across the whole sweep
+    additions = plan_additions(plans)
     caps: dict = {float(b): int(n) for b, n in warm_counts.items()}
-    for plan in plans:
-        for k, b in zip(plan.num_configs, plan.budgets):
-            caps[float(b)] = caps.get(float(b), 0) + int(k)
+    for b, k in additions.items():
+        caps[b] = caps.get(b, 0) + k
     if capacities is not None:
         for b, need in caps.items():
             if capacities.get(float(b), 0) < need:
@@ -779,14 +791,11 @@ def make_fused_sweep_fn(
             # caller count truncates its newest warm rows deterministically
             # instead of silently clobbering fresh observations through
             # dynamic_update_slice's start-index clamping.
-            additions = {b: 0 for b in caps}
-            for plan in plans:
-                for k, b in zip(plan.num_configs, plan.budgets):
-                    additions[float(b)] += int(k)
             obs_v, obs_l, counts = {}, {}, {}
             for b, cap in caps.items():
                 n_b = jnp.minimum(
-                    jnp.asarray(warm_n[b], jnp.int32), cap - additions[b]
+                    jnp.asarray(warm_n[b], jnp.int32),
+                    cap - additions.get(b, 0),
                 )
                 live = jnp.arange(cap, dtype=jnp.int32) < n_b
                 v = jnp.asarray(warm_v[b], jnp.float32)

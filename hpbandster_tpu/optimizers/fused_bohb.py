@@ -410,12 +410,13 @@ class FusedBOHB:
                 # doubling-dense territory and recompiled almost every
                 # chunk (measured: 8 compiles/9 chunks). Masked model math
                 # over >=256 rows is trivial device work next to that.
+                from hpbandster_tpu.ops.sweep import plan_additions
+
                 run_caps = {
                     float(b): len(l) for b, l in self._warm_l.items()
                 }
-                for p in chunk_plans:
-                    for k, b in zip(p.num_configs, p.budgets):
-                        run_caps[float(b)] = run_caps.get(float(b), 0) + int(k)
+                for b, k in plan_additions(chunk_plans).items():
+                    run_caps[b] = run_caps.get(b, 0) + k
                 run_caps = {
                     b: 1 << max(int(n) - 1, 255).bit_length()
                     for b, n in run_caps.items()

@@ -123,6 +123,15 @@ def _modern_result():
                 "shape": "128x64x256 d=6", "pallas_speedup": 4.0,
                 "pallas_median_s": 0.001, "xla_median_s": 0.004,
             },
+            "chunked_compile_static_vs_dynamic": {
+                "schedule": "9 brackets, chunk 3, budgets 1..9",
+                "static": {"first_run_wall_s": 32.4, "chunks": 3,
+                           "fresh_compiles": 3, "compile_s_total": 32.4},
+                "dynamic": {"first_run_wall_s": 12.7, "chunks": 3,
+                            "fresh_compiles": 1, "compile_s_total": 12.5},
+                "fresh_compiles_static_vs_dynamic": [3, 1],
+                "first_run_wall_speedup": 2.56,
+            },
         },
     }
 
@@ -137,6 +146,8 @@ class TestWriteBaseline:
         assert "incumbent val acc 0.750" in text
         assert "MXU probe" in text and "60.0%" in text
         assert "Pallas acquisition scorer" in text and "4.00x" in text
+        assert "Chunked-sweep compile reuse" in text
+        assert "3 fresh compiles static vs 1 dynamic-count" in text
 
     def test_legacy_r02_cnn_schema_renders_what_it_holds(self, tmp_path):
         # the r02-era cnn dict has no device-time split: the rung must show
