@@ -34,7 +34,11 @@ from hpbandster_tpu.ops.bracket import (
     hyperband_bracket,
     max_sh_iterations,
 )
-from hpbandster_tpu.ops.sweep import build_space_codec, make_fused_sweep_fn
+from hpbandster_tpu.ops.sweep import (
+    build_space_codec,
+    make_fused_sweep_fn,
+    plan_additions,
+)
 from hpbandster_tpu.space import ConfigurationSpace
 from hpbandster_tpu.utils.lru import LRUCache
 
@@ -410,8 +414,6 @@ class FusedBOHB:
                 # doubling-dense territory and recompiled almost every
                 # chunk (measured: 8 compiles/9 chunks). Masked model math
                 # over >=256 rows is trivial device work next to that.
-                from hpbandster_tpu.ops.sweep import plan_additions
-
                 run_caps = {
                     float(b): len(l) for b, l in self._warm_l.items()
                 }

@@ -938,6 +938,52 @@ class TestDynamicCountSweep:
             e["config_info"].get("model_based_pick") for e in id2c.values()
         )
 
+    @pytest.mark.slow
+    def test_dynamic_composes_warmstart_conditions_forbiddens(self):
+        # the newest paths COMPOSED: a conditional space with a forbidden
+        # clause, run chunked (dynamic tier), warm-started from a previous
+        # Result — warm NaN-carrying vectors ride the capacity buffers into
+        # the rank-masked imputing fit, forbiddens keep resampling in-trace,
+        # and the old data still lands under negative iteration ids
+        from hpbandster_tpu.space.forbidden import ForbiddenEqualsClause
+
+        cs = ConfigurationSpace(seed=0)
+        x = UniformFloatHyperparameter("x", -5.0, 10.0)
+        arm = CategoricalHyperparameter("arm", ["p", "q", "r"])
+        mom = UniformFloatHyperparameter("momentum", 0.0, 0.99)
+        cs.add_hyperparameters([x, arm, mom])
+        cs.add_condition(EqualsCondition(mom, arm, "p"))
+        cs.add_forbidden_clause(ForbiddenEqualsClause(arm, "q"))
+
+        def eval_fn(vec, budget):
+            return vec[0] * vec[0] + 0.1 * vec[2] + 0.0 * budget
+
+        def mk(seed, prev=None):
+            return FusedBOHB(
+                configspace=cs, eval_fn=eval_fn, run_id=f"dyn-mix-{seed}",
+                min_budget=1, max_budget=9, eta=3, seed=seed,
+                min_points_in_model=5, previous_result=prev,
+            )
+
+        cold = mk(71)
+        prev = cold.run(n_iterations=3, chunk_brackets=2)
+        cold.shutdown()
+        warm = mk(72, prev=prev)
+        res = warm.run(n_iterations=3, chunk_brackets=2)
+        warm.shutdown()
+        assert all(s["dynamic_counts"] for s in warm.run_stats)
+        id2c = res.get_id2config_mapping()
+        assert any(cid[0] < 0 for cid in id2c)  # warm data rode along
+        live = {cid: e for cid, e in id2c.items() if cid[0] >= 0}
+        assert any(
+            e["config_info"].get("model_based_pick") for e in live.values()
+        ), "warm start did not open the model gate on the dynamic tier"
+        for entry in live.values():
+            cfg = entry["config"]
+            assert cfg["arm"] in ("p", "r")  # forbidden clause held
+            assert ("momentum" in cfg) == (cfg["arm"] == "p"), cfg
+            assert not cs.is_forbidden(cfg)
+
     def test_dynamic_with_pallas_scorer_interpreted(self):
         # on a real TPU chunked FusedBOHB runs dynamic counts WITH the
         # Pallas scorer (default-on there) — trace that combination via the
