@@ -15,6 +15,13 @@ Scale up with ``--n_iterations`` (brackets cycle through the ladder's
 shapes) or ``--max_budget 243`` (deeper ladder, wider stage-0 waves). On a
 pod slice the same script shards every wave across chips via
 ``config_mesh(jax.devices())``.
+
+For LONG fused sweeps add ``--chunk_brackets K`` (+ optionally
+``--checkpoint PATH``): the schedule runs in fused K-bracket chunks on
+the dynamic-count tier — consecutive chunks reuse one compiled program,
+results stream after every chunk, and a killed run resumes from the last
+chunk boundary (rebuild the same optimizer, ``load_checkpoint(PATH)``,
+call ``run()`` again) reproducing the uninterrupted run exactly.
 """
 
 import argparse
@@ -33,7 +40,21 @@ def main():
     p.add_argument("--eta", type=float, default=3)
     p.add_argument("--max_budget", type=float, default=81)
     p.add_argument("--fused", action=argparse.BooleanOptionalAction, default=True)
+    p.add_argument(
+        "--chunk_brackets", type=int, default=None,
+        help="fused mode: run in K-bracket chunks (dynamic-count tier; "
+             "compile reuse + streamed results + resumable boundaries)",
+    )
+    p.add_argument(
+        "--checkpoint", default=None,
+        help="fused+chunked mode: write a resumable checkpoint after "
+             "every chunk",
+    )
     args = p.parse_args()
+    if not args.fused and (
+        args.chunk_brackets is not None or args.checkpoint is not None
+    ):
+        p.error("--chunk_brackets/--checkpoint require the fused mode")
 
     cs = branin_space(seed=0)
     devices = jax.devices()
@@ -54,13 +75,28 @@ def main():
         )
 
     t0 = time.perf_counter()
-    res = opt.run(n_iterations=args.n_iterations)
+    if args.fused:
+        res = opt.run(
+            n_iterations=args.n_iterations,
+            chunk_brackets=args.chunk_brackets,
+            checkpoint_path=args.checkpoint,
+        )
+    else:
+        res = opt.run(n_iterations=args.n_iterations)
     dt = time.perf_counter() - t0
     opt.shutdown()
 
     runs = res.get_all_runs()
     traj = res.get_incumbent_trajectory()
     mode = "fused whole-sweep" if args.fused else "per-bracket batched"
+    if args.chunk_brackets is not None:
+        fresh = sum(
+            1 for s in opt.run_stats if not s["compile_cache_hit"]
+        )
+        mode += (
+            f", {args.chunk_brackets}-bracket chunks "
+            f"({len(opt.run_stats)} chunks, {fresh} fresh compiles)"
+        )
     print(f"devices: {len(devices)} ({devices[0].platform}); mode: {mode}")
     print(
         f"{len(runs)} evaluations, {args.n_iterations} brackets, {dt:.1f}s "

@@ -93,6 +93,16 @@ def test_example_8_large_sweep():
     assert "fused whole-sweep" in out
 
 
+def test_example_8_large_sweep_chunked_checkpoint(tmp_path):
+    out = run_example(
+        "example_8_large_sweep.py", "--n_iterations", "4", "--max_budget", "9",
+        "--chunk_brackets", "2", "--checkpoint", str(tmp_path / "sweep.pkl"),
+    )
+    assert "incumbent loss" in out
+    assert "2-bracket chunks" in out
+    assert (tmp_path / "sweep.pkl").exists()
+
+
 def test_example_8_large_sweep_per_bracket():
     out = run_example(
         "example_8_large_sweep.py", "--n_iterations", "4", "--max_budget", "9",
