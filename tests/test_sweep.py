@@ -984,6 +984,27 @@ class TestDynamicCountSweep:
             assert ("momentum" in cfg) == (cfg["arm"] == "p"), cfg
             assert not cs.is_forbidden(cfg)
 
+    def test_dynamic_warm_continuation_reuses_executable(self):
+        # iterative continuation (run -> inspect -> run more) on the forced
+        # dynamic tier: the second run() call's brackets cycle through the
+        # same plan shapes within the same capacity bucket, so the warm
+        # continuation REUSES the first call's executable — the static
+        # trace would recompile at the new warm-observation counts
+        opt = self._mk(seed=81, min_points_in_model=5)
+        opt.run(n_iterations=3, dynamic_counts=True)
+        res = opt.run(n_iterations=6, dynamic_counts=True)
+        opt.shutdown()
+        fresh = [s for s in opt.run_stats if not s["compile_cache_hit"]]
+        assert len(opt.run_stats) == 2 and len(fresh) == 1
+        id2c = res.get_id2config_mapping()
+        # restrict to the CONTINUATION's brackets (>=3) — the first call's
+        # brackets already contain model picks, which would mask a
+        # regression where run 2 drops the accumulated observations
+        assert any(
+            e["config_info"].get("model_based_pick")
+            for cid, e in id2c.items() if cid[0] >= 3
+        ), "continuation did not see the first call's observations"
+
     def test_dynamic_with_pallas_scorer_interpreted(self):
         # on a real TPU chunked FusedBOHB runs dynamic counts WITH the
         # Pallas scorer (default-on there) — trace that combination via the
