@@ -121,11 +121,19 @@ def main() -> None:
         min_points_in_model=5,
         result_logger=None,  # side effects would need the primary gate
     )
-    # two run() calls: the second threads the first's observations in as
-    # warm data, so the warm-pytree argument path (global replicated arrays
-    # from host-local numpy on every rank) is exercised under DCN too
+    # three run() calls cover every fused argument signature under DCN:
+    # call 1 — static warm-free (seed,); call 2 — static warm 3-arg
+    # ((seed, warm_v, warm_l): ragged per-budget host-numpy pytrees to
+    # global replicated arrays on every rank); call 3 — chunked, the
+    # DYNAMIC-count tier's 4-arg signature (full-capacity warm buffers +
+    # traced i32 counts through the same to_global conversion)
+    fopt.run(n_iterations=1)
     fopt.run(n_iterations=2)
-    fres = fopt.run(n_iterations=3)
+    fres = fopt.run(n_iterations=3, chunk_brackets=1)
+    assert not fopt.run_stats[1]["dynamic_counts"], \
+        "unchunked warm continuation must stay on the static tier"
+    assert fopt.run_stats[-1]["dynamic_counts"], \
+        "chunked continuation must take the dynamic tier"
     fruns = sorted(
         (list(r.config_id), float(r.budget), float(r.loss))
         for r in fres.get_all_runs()

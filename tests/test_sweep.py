@@ -994,8 +994,11 @@ class TestDynamicCountSweep:
         opt.run(n_iterations=3, dynamic_counts=True)
         res = opt.run(n_iterations=6, dynamic_counts=True)
         opt.shutdown()
-        fresh = [s for s in opt.run_stats if not s["compile_cache_hit"]]
-        assert len(opt.run_stats) == 2 and len(fresh) == 1
+        # the claim is ONLY that run 2 reuses run 1's executable — run 1
+        # itself may hit the process-global cache if an earlier test built
+        # the same sweep, so don't require it to have compiled fresh
+        assert len(opt.run_stats) == 2
+        assert opt.run_stats[1]["compile_cache_hit"]
         id2c = res.get_id2config_mapping()
         # restrict to the CONTINUATION's brackets (>=3) — the first call's
         # brackets already contain model picks, which would mask a
