@@ -20,13 +20,16 @@ from typing import Callable, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = ["fused_sh_bracket", "make_fused_bracket_fn"]
 
 #: crashed (NaN) losses map here for ranking: behind any real loss, ahead of
 #: the +inf padding rows, ties broken index-stably by top_k — the same
-#: ordering sh_promotion_mask's argsort produces host-side.
-_CRASH_RANK = jnp.float32(3.0e38)
+#: ordering sh_promotion_mask's argsort produces host-side. numpy, NOT a
+#: jnp scalar: module-level device-array creation would initialize the jax
+#: backend at import time (see workloads/toys.py).
+_CRASH_RANK = np.float32(3.0e38)
 
 
 def fused_sh_bracket(

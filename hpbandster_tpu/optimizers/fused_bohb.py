@@ -136,6 +136,35 @@ class FusedBOHB:
             self.forbidden_fn = None
             self._fallback_vector = None
             self._forbiddens_sig = ()
+        # fail fast on a non-scalar objective: without this check the first
+        # run() dies with an opaque XLA broadcasting error from deep inside
+        # the sweep trace. jax.eval_shape is abstract (no backend or device
+        # work); the budget is passed CONCRETE exactly as the sweep does,
+        # so Python-level loops over epochs inside eval_fn stay legal —
+        # min_budget keeps any such unrolling as small as possible.
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        d = int(self.codec.kind.shape[0])
+        try:
+            out_sds = _jax.eval_shape(
+                lambda v: eval_fn(v, float(min_budget)),
+                _jax.ShapeDtypeStruct((d,), _jnp.float32),
+            )
+        except Exception as e:
+            raise ValueError(
+                f"eval_fn(config_vector f32[{d}], budget) is not traceable "
+                f"for this {d}-dim space: {type(e).__name__}: {e}"
+            ) from e
+        leaves = _jax.tree_util.tree_leaves(out_sds)
+        shapes = [tuple(getattr(l, "shape", ())) for l in leaves]
+        if len(leaves) != 1 or shapes[0] != ():
+            raise ValueError(
+                "eval_fn must return a single SCALAR loss, got "
+                f"{len(leaves)} output leaves with shapes {shapes} — "
+                "reduce per-example losses (e.g. .mean()) and drop aux "
+                "outputs before returning"
+            )
         self.eval_fn = eval_fn
         self.run_id = run_id
         self.eta = float(eta)

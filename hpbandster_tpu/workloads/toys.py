@@ -64,22 +64,28 @@ def hartmann6_space(seed=None) -> ConfigurationSpace:
     return cs
 
 
-_H6_ALPHA = jnp.array([1.0, 1.2, 3.0, 3.2])
-_H6_A = jnp.array(
+# numpy, NOT jnp: module-level device-array creation would initialize the
+# jax backend at IMPORT time (slow, grabs the accelerator, and hangs
+# outright when a tunneled TPU plugin is unreachable); numpy constants
+# lift into traces identically
+_H6_ALPHA = np.array([1.0, 1.2, 3.0, 3.2], np.float32)
+_H6_A = np.array(
     [
         [10, 3, 17, 3.5, 1.7, 8],
         [0.05, 10, 17, 0.1, 8, 14],
         [3, 3.5, 1.7, 10, 17, 8],
         [17, 8, 0.05, 10, 0.1, 14],
-    ]
+    ],
+    np.float32,
 )
-_H6_P = 1e-4 * jnp.array(
+_H6_P = 1e-4 * np.array(
     [
         [1312, 1696, 5569, 124, 8283, 5886],
         [2329, 4135, 8307, 3736, 1004, 9991],
         [2348, 1451, 3522, 2883, 3047, 6650],
         [4047, 8828, 8732, 5743, 1091, 381],
-    ]
+    ],
+    np.float32,
 )
 
 

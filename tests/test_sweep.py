@@ -844,6 +844,39 @@ class TestFusedSweep:
         plans = hyperband_schedule(3, 1, 27, 3)
         assert len(runs) == sum(p.num_configs[0] for p in plans)
 
+    def test_non_scalar_eval_fn_rejected_at_construction(self):
+        # without the construction-time eval_shape check this surfaced as
+        # an opaque XLA broadcasting error from deep inside the sweep trace
+        cs = branin_space(seed=0)
+        with pytest.raises(ValueError, match="SCALAR loss"):
+            FusedBOHB(
+                configspace=cs, eval_fn=lambda vec, budget: vec,
+                run_id="bad", min_budget=1, max_budget=9, eta=3, seed=0,
+            )
+
+    def test_pytree_eval_fn_rejected_at_construction(self):
+        # the (loss, aux) pattern returns a TUPLE from eval_shape — the
+        # check must see through pytrees, not just array shapes
+        cs = branin_space(seed=0)
+        with pytest.raises(ValueError, match="SCALAR loss"):
+            FusedBOHB(
+                configspace=cs,
+                eval_fn=lambda vec, budget: (vec.sum(), {"aux": vec}),
+                run_id="bad3", min_budget=1, max_budget=9, eta=3, seed=0,
+            )
+
+    def test_untraceable_eval_fn_rejected_at_construction(self):
+        cs = branin_space(seed=0)
+
+        def bad(vec, budget):
+            return float(vec[0])  # concretizes a tracer
+
+        with pytest.raises(ValueError, match="not traceable"):
+            FusedBOHB(
+                configspace=cs, eval_fn=bad, run_id="bad2",
+                min_budget=1, max_budget=9, eta=3, seed=0,
+            )
+
     def test_deterministic_given_seed(self):
         cs = branin_space(seed=0)
 
