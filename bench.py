@@ -576,13 +576,20 @@ def bench_chunked_compile(n_iterations=9, chunk=3, max_budget=9, seed=70):
 def _run_tier(errors, name, fn, *args, **kwargs):
     """Run one bench tier; a failure records the error and returns None
     instead of killing the whole bench (VERDICT r3 weak #1: one flake must
-    not cost the round its numbers)."""
+    not cost the round its numbers). Start/finish lines go to stderr so a
+    killed-by-timeout run still shows WHICH tier ate the clock."""
+    print("bench: tier %r starting" % name, file=sys.stderr, flush=True)
+    t0 = time.perf_counter()
     try:
-        return fn(*args, **kwargs)
+        out = fn(*args, **kwargs)
+        print("bench: tier %r done in %.1fs" % (name, time.perf_counter() - t0),
+              file=sys.stderr, flush=True)
+        return out
     except Exception as e:  # noqa: BLE001 — last-resort isolation
         errors[name] = "%s: %s" % (type(e).__name__, str(e)[:300])
-        print("bench: tier %r failed: %s" % (name, errors[name]),
-              file=sys.stderr)
+        print("bench: tier %r failed after %.1fs: %s"
+              % (name, time.perf_counter() - t0, errors[name]),
+              file=sys.stderr, flush=True)
         return None
 
 
@@ -610,9 +617,24 @@ def collect(backend_error=None, platform=None, smoke=False):
     repeats = 3 if smoke else RUNS_PER_TIER
     brackets = 4 if smoke else HEADLINE_BRACKETS
     max_budget = 9 if smoke else 81
+    fallback_schedule = None
+    if backend_error and not smoke:
+        # unplanned CPU fallback: the artifact's job is to EXIST and say
+        # why it is degraded — its numbers are non-citable by policy
+        # (write_baseline refuses artifacts with an error field). The full
+        # 27-bracket 1..81 program costs tens of minutes of CPU compile,
+        # long enough to risk the archiving driver's timeout eating the
+        # whole artifact (measured: >75 min for the full tier set), so the
+        # fallback measures a REDUCED, labeled schedule instead.
+        brackets, max_budget, repeats = 9, 27, 3
+        fallback_schedule = (
+            "CPU fallback: fused reduced to 9 brackets, budgets 1..27"
+        )
     fused_out = _run_tier(errors, "fused", bench_fused, brackets,
                           repeats=repeats, max_budget=max_budget)
     fused = scaled_summary(fused_out[0]) if fused_out else None
+    if fused is not None and fallback_schedule:
+        fused["fallback_schedule"] = fallback_schedule
     if smoke:
         # --smoke: exercise the full collect pipeline (probe/fallback/
         # error isolation/JSON contract) in minutes, not the measurement
@@ -625,33 +647,60 @@ def collect(backend_error=None, platform=None, smoke=False):
         pallas = _run_tier(errors, "pallas", bench_pallas_scorer,
                            repeats=repeats)
     else:
-        fused10k_out = _run_tier(errors, "fused10k", bench_fused, 36,
-                                 repeats=repeats, max_budget=729, seed=50)
-        fused10k = scaled_summary(fused10k_out[0]) if fused10k_out else None
-        if fused10k is not None:
+        if backend_error:
+            # unplanned CPU fallback: the 36-bracket 1..729 program exists
+            # only to measure on-chip scale, and its CPU compile alone can
+            # run to an hour — long enough to risk the archiving driver's
+            # timeout eating the WHOLE artifact. Record why it is absent
+            # and keep the fallback run bounded; the headline fused tier
+            # (27 brackets, minutes on CPU) still measures.
+            fused10k_out = None
+            fused10k = {
+                "skipped": "TPU unavailable; the 10k-scale program's CPU "
+                           "compile is unboundedly slow and measures "
+                           "nothing the fallback artifact needs"
+            }
+        else:
+            fused10k_out = _run_tier(errors, "fused10k", bench_fused, 36,
+                                     repeats=repeats, max_budget=729, seed=50)
+            fused10k = (
+                scaled_summary(fused10k_out[0]) if fused10k_out else None
+            )
+        if fused10k is not None and fused10k_out is not None:
             fused10k["total_configs_per_run"] = fused10k_out[1]
-        batched_rates = _run_tier(errors, "batched", bench_batched,
-                                  repeats=repeats)
-        batched = scaled_summary(batched_rates)
+        if backend_error:
+            # per-bracket compiles across the 1..81 ladder are the other
+            # tens-of-minutes CPU-compile sink; like the MXU rungs, the
+            # tier measures nothing citable on the fallback backend
+            batched = {
+                "skipped": "TPU unavailable; per-bracket 1..81 compiles "
+                           "are tens of CPU-minutes for non-citable "
+                           "numbers"
+            }
+        else:
+            batched_rates = _run_tier(errors, "batched", bench_batched,
+                                      repeats=repeats)
+            batched = scaled_summary(batched_rates)
         rpc_rates = _run_tier(errors, "rpc", bench_rpc_baseline,
                               repeats=repeats)
         rpc = _summary(rpc_rates) if rpc_rates else None
-        cnn = _run_tier(errors, "cnn", bench_cnn)
         if backend_error:
-            # unplanned CPU fallback: cnn_wide and resnet exist ONLY to
-            # measure MXU saturation — on CPU they'd burn ~an hour of conv
-            # training to produce no MFU (unknown peak), delaying the
-            # artifact the fallback exists to save. Record WHY they are
-            # absent instead. bench_cnn stays: it is CPU-affordable
-            # (~1-2 min) and carries the target_met generalization claim,
-            # which is backend-independent; bench_teacher stays because the
-            # MLP rung is seconds on CPU and reports only *_incl_host
-            # utilization to begin with.
-            skip = {"skipped": "TPU unavailable; MXU-saturation rungs are "
-                               "meaningless on the CPU fallback backend"}
+            # unplanned CPU fallback: every conv rung is tens of CPU-
+            # minutes (measured: the cnn sweep alone pushed the fallback
+            # bench past a 50-minute timeout — an artifact-eating risk),
+            # and cnn_wide/resnet measure MXU saturation that does not
+            # exist on CPU. Record WHY they are absent; bench_teacher
+            # keeps a generalization signal because the MLP rung is
+            # seconds on CPU and reports only *_incl_host utilization to
+            # begin with.
+            skip = {"skipped": "TPU unavailable; conv rungs cost tens of "
+                               "CPU-minutes (timeout risk) for numbers "
+                               "the fallback artifact cannot cite"}
+            cnn = dict(skip)
             cnn_wide = dict(skip)
             resnet = dict(skip)
         else:
+            cnn = _run_tier(errors, "cnn", bench_cnn)
             cnn_wide = _run_tier(errors, "cnn_wide", bench_cnn_wide)
             resnet = _run_tier(errors, "resnet", bench_resnet)
         teacher = _run_tier(errors, "teacher", bench_teacher)
@@ -702,6 +751,13 @@ def collect(backend_error=None, platform=None, smoke=False):
         result["smoke"] = True
         result["metric"] = (
             "configs evaluated/sec/chip (SMOKE: 4 brackets, budgets 1..9)"
+        )
+    elif fallback_schedule:
+        # same honesty rule as --smoke: the headline fields must not look
+        # comparable to a real chip run's 27-bracket 1..81 numbers
+        result["metric"] = (
+            "configs evaluated/sec/chip (CPU FALLBACK: 9 brackets, "
+            "budgets 1..27; batched/fused10k/conv rungs skipped)"
         )
     if errors:
         result["error"] = errors
