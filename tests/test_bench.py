@@ -242,3 +242,76 @@ class TestWriteBaselineFromGuards:
         r["detail"]["tiers"]["rpc_pool_1worker"] = {"median": 1.0, "iqr": None}
         bench.write_baseline(r, path=path)  # must not raise
         assert "| Host RPC pool (reference architecture, 1 worker) | not measured" in open(path).read()
+
+
+class TestFallbackContract:
+    """The CPU-fallback collect() must be bounded AND honestly labeled:
+    conv/batched/10k tiers skip with recorded reasons, the fused tier runs
+    a reduced schedule that the metric string and tier dict both declare,
+    and the backend error rides the artifact (bench.py fallback branch)."""
+
+    def _stub_tiers(self, monkeypatch, calls):
+        def fused(brackets, repeats=5, max_budget=81, seed=0):
+            calls.setdefault("fused", []).append(
+                {"brackets": brackets, "max_budget": max_budget,
+                 "repeats": repeats}
+            )
+            return [100.0, 110.0, 120.0], 50
+        monkeypatch.setattr(bench, "bench_fused", fused)
+        monkeypatch.setattr(
+            bench, "bench_rpc_baseline",
+            lambda repeats=5, **kw: [10.0, 11.0, 12.0])
+        monkeypatch.setattr(
+            bench, "bench_batched",
+            lambda **kw: calls.setdefault("batched", True)
+            and [1.0, 2.0, 3.0])
+        monkeypatch.setattr(bench, "bench_cnn",
+                            lambda **kw: calls.setdefault("cnn", True) and {})
+        monkeypatch.setattr(bench, "bench_cnn_wide", lambda **kw: {})
+        monkeypatch.setattr(bench, "bench_resnet", lambda **kw: {})
+        monkeypatch.setattr(bench, "bench_teacher", lambda **kw: {"t": 1})
+        monkeypatch.setattr(bench, "bench_pallas_scorer",
+                            lambda **kw: {"pallas_speedup": 2.0})
+        monkeypatch.setattr(bench, "bench_chunked_compile",
+                            lambda **kw: {"fresh_compiles_static_vs_dynamic":
+                                          [3, 1]})
+
+    def test_fallback_reduces_and_relabels(self, monkeypatch):
+        calls = {}
+        self._stub_tiers(monkeypatch, calls)
+        r = bench.collect(backend_error="tunnel dead", platform="cpu")
+        # reduced, labeled fused schedule; the 10k fused variant never ran
+        assert calls["fused"] == [
+            {"brackets": 9, "max_budget": 27, "repeats": 3}
+        ]
+        assert "CPU FALLBACK" in r["metric"]
+        d = r["detail"]
+        fused = d["tiers"]["fused_27_brackets"]
+        assert "fallback_schedule" in fused
+        # compile-heavy tiers skipped with recorded reasons, never run
+        assert "skipped" in d["tiers"]["batched_parallel_brackets3"]
+        assert "skipped" in d["tiers"]["fused_10k_scale_36_brackets_1_729"]
+        for k in ("cnn_workload_budget_sgd_steps", "cnn_wide_mxu_saturation",
+                  "resnet_workload_budget_sgd_steps"):
+            assert "skipped" in d[k]
+        assert "batched" not in calls and "cnn" not in calls
+        # cheap informative tiers still measured; the error rides along
+        assert d["teacher_workload_budget_epochs"] == {"t": 1}
+        assert d["chunked_compile_static_vs_dynamic"][
+            "fresh_compiles_static_vs_dynamic"] == [3, 1]
+        assert r["error"]["backend"] == "tunnel dead"
+        assert r["value"] is not None and r["vs_baseline"] is not None
+        # the method string must describe THIS artifact, not the full run
+        assert "DEGRADED CPU-FALLBACK" in d["method"]
+        assert "skipped" in d["method"]
+
+    def test_healthy_run_keeps_full_schedule(self, monkeypatch):
+        calls = {}
+        self._stub_tiers(monkeypatch, calls)
+        r = bench.collect(backend_error=None, platform=None)
+        assert calls["fused"][0]["brackets"] == bench.HEADLINE_BRACKETS
+        assert calls["fused"][0]["max_budget"] == 81
+        assert calls["fused"][1]["brackets"] == 36  # 10k tier ran too
+        assert "CPU FALLBACK" not in r["metric"]
+        assert "batched" in calls and "cnn" in calls
+        assert "error" not in r
