@@ -793,13 +793,32 @@ def make_fused_sweep_fn(
             # dynamic_update_slice's start-index clamping.
             obs_v, obs_l, counts = {}, {}, {}
             for b, cap in caps.items():
+                # a budget present in `capacities` but absent from the
+                # warm inputs (exported-API callers may oversize the
+                # capacity map for a later chunk) defaults to an empty
+                # count-0 buffer instead of a trace-time KeyError
+                # (ADVICE r4); a budget present in only SOME of the three
+                # warm dicts is a caller bug — name it instead of letting
+                # warm_v[b] raise bare or silently dropping the data
+                have = warm_n is not None and b in warm_n
+                have_v = warm_v is not None and b in warm_v
+                have_l = warm_l is not None and b in warm_l
+                if not (have == have_v == have_l):
+                    raise ValueError(
+                        f"inconsistent warm inputs for budget {b}: present "
+                        f"in warm_n={have}, warm_v={have_v}, "
+                        f"warm_l={have_l} — each budget must appear in all "
+                        f"three dicts or none"
+                    )
                 n_b = jnp.minimum(
-                    jnp.asarray(warm_n[b], jnp.int32),
+                    jnp.asarray(warm_n[b] if have else 0, jnp.int32),
                     cap - additions.get(b, 0),
                 )
                 live = jnp.arange(cap, dtype=jnp.int32) < n_b
-                v = jnp.asarray(warm_v[b], jnp.float32)
-                l = jnp.asarray(warm_l[b], jnp.float32)
+                v = (jnp.asarray(warm_v[b], jnp.float32) if have
+                     else jnp.zeros((cap, d), jnp.float32))
+                l = (jnp.asarray(warm_l[b], jnp.float32) if have
+                     else jnp.full((cap,), jnp.inf, jnp.float32))
                 obs_v[b] = jnp.where(live[:, None], v, 0.0)
                 obs_l[b] = jnp.where(
                     live & ~jnp.isnan(l), l, jnp.inf

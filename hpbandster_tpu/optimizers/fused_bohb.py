@@ -152,9 +152,15 @@ class FusedBOHB:
                 _jax.ShapeDtypeStruct((d,), _jnp.float32),
             )
         except Exception as e:
+            # deliberately broad: eval_shape surfaces plain bugs inside
+            # eval_fn (wrong arity, NameError) as well as tracing errors,
+            # so the banner says what was ATTEMPTED, not what went wrong —
+            # the chained original exception carries the real diagnosis
+            # (ADVICE r4)
             raise ValueError(
-                f"eval_fn(config_vector f32[{d}], budget) is not traceable "
-                f"for this {d}-dim space: {type(e).__name__}: {e}"
+                f"eval_fn(config_vector f32[{d}], budget) failed under "
+                f"abstract evaluation (jax.eval_shape) for this {d}-dim "
+                f"space: {type(e).__name__}: {e}"
             ) from e
         leaves = _jax.tree_util.tree_leaves(out_sds)
         shapes = [tuple(getattr(l, "shape", ())) for l in leaves]

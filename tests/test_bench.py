@@ -244,41 +244,42 @@ class TestWriteBaselineFromGuards:
         assert "| Host RPC pool (reference architecture, 1 worker) | not measured" in open(path).read()
 
 
+def _stub_tiers(monkeypatch, calls):
+    def fused(brackets, repeats=5, max_budget=81, seed=0):
+        calls.setdefault("fused", []).append(
+            {"brackets": brackets, "max_budget": max_budget,
+             "repeats": repeats}
+        )
+        return [100.0, 110.0, 120.0], 50
+    monkeypatch.setattr(bench, "bench_fused", fused)
+    monkeypatch.setattr(
+        bench, "bench_rpc_baseline",
+        lambda repeats=5, **kw: [10.0, 11.0, 12.0])
+    monkeypatch.setattr(
+        bench, "bench_batched",
+        lambda **kw: calls.setdefault("batched", True)
+        and [1.0, 2.0, 3.0])
+    monkeypatch.setattr(bench, "bench_cnn",
+                        lambda **kw: calls.setdefault("cnn", True) and {})
+    monkeypatch.setattr(bench, "bench_cnn_wide", lambda **kw: {})
+    monkeypatch.setattr(bench, "bench_resnet", lambda **kw: {})
+    monkeypatch.setattr(bench, "bench_teacher", lambda **kw: {"t": 1})
+    monkeypatch.setattr(bench, "bench_pallas_scorer",
+                        lambda **kw: {"pallas_speedup": 2.0})
+    monkeypatch.setattr(bench, "bench_chunked_compile",
+                        lambda **kw: {"fresh_compiles_static_vs_dynamic":
+                                      [3, 1]})
+
+
 class TestFallbackContract:
     """The CPU-fallback collect() must be bounded AND honestly labeled:
     conv/batched/10k tiers skip with recorded reasons, the fused tier runs
     a reduced schedule that the metric string and tier dict both declare,
     and the backend error rides the artifact (bench.py fallback branch)."""
 
-    def _stub_tiers(self, monkeypatch, calls):
-        def fused(brackets, repeats=5, max_budget=81, seed=0):
-            calls.setdefault("fused", []).append(
-                {"brackets": brackets, "max_budget": max_budget,
-                 "repeats": repeats}
-            )
-            return [100.0, 110.0, 120.0], 50
-        monkeypatch.setattr(bench, "bench_fused", fused)
-        monkeypatch.setattr(
-            bench, "bench_rpc_baseline",
-            lambda repeats=5, **kw: [10.0, 11.0, 12.0])
-        monkeypatch.setattr(
-            bench, "bench_batched",
-            lambda **kw: calls.setdefault("batched", True)
-            and [1.0, 2.0, 3.0])
-        monkeypatch.setattr(bench, "bench_cnn",
-                            lambda **kw: calls.setdefault("cnn", True) and {})
-        monkeypatch.setattr(bench, "bench_cnn_wide", lambda **kw: {})
-        monkeypatch.setattr(bench, "bench_resnet", lambda **kw: {})
-        monkeypatch.setattr(bench, "bench_teacher", lambda **kw: {"t": 1})
-        monkeypatch.setattr(bench, "bench_pallas_scorer",
-                            lambda **kw: {"pallas_speedup": 2.0})
-        monkeypatch.setattr(bench, "bench_chunked_compile",
-                            lambda **kw: {"fresh_compiles_static_vs_dynamic":
-                                          [3, 1]})
-
     def test_fallback_reduces_and_relabels(self, monkeypatch):
         calls = {}
-        self._stub_tiers(monkeypatch, calls)
+        _stub_tiers(monkeypatch, calls)
         r = bench.collect(backend_error="tunnel dead", platform="cpu")
         # reduced, labeled fused schedule; the 10k fused variant never ran
         assert calls["fused"] == [
@@ -307,11 +308,280 @@ class TestFallbackContract:
 
     def test_healthy_run_keeps_full_schedule(self, monkeypatch):
         calls = {}
-        self._stub_tiers(monkeypatch, calls)
+        _stub_tiers(monkeypatch, calls)
         r = bench.collect(backend_error=None, platform=None)
-        assert calls["fused"][0]["brackets"] == bench.HEADLINE_BRACKETS
-        assert calls["fused"][0]["max_budget"] == 81
-        assert calls["fused"][1]["brackets"] == 36  # 10k tier ran too
+        # evidence-value order: the 10k tier (never chip-measured) runs
+        # BEFORE the headline fused tier (measured in r02)
+        assert calls["fused"][0]["brackets"] == 36
+        assert calls["fused"][1]["brackets"] == bench.HEADLINE_BRACKETS
+        assert calls["fused"][1]["max_budget"] == 81
         assert "CPU FALLBACK" not in r["metric"]
         assert "batched" in calls and "cnn" in calls
         assert "error" not in r
+
+
+class TestTierSelection:
+    """--tiers runs a subset; everything else is marked, never run."""
+
+    def test_only_selected_tiers_run(self, monkeypatch):
+        calls = {}
+        _stub_tiers(monkeypatch, calls)
+        r = bench.collect(backend_error=None, platform=None,
+                          tiers={"cnn", "pallas"})
+        assert "cnn" in calls
+        assert "fused" not in calls and "batched" not in calls
+        d = r["detail"]
+        assert "skipped" in d["tiers"]["fused_27_brackets"]
+        assert "skipped" in d["tiers"]["rpc_pool_1worker"]
+        assert d["cnn_workload_budget_sgd_steps"] == {}
+        assert d["pallas_scorer_vs_xla"]["pallas_speedup"] == 2.0
+        # no fused/rpc -> no headline, but the artifact still exists
+        assert r["value"] is None and r["vs_baseline"] is None
+
+    def test_unknown_tier_name_rejected(self, capsys):
+        with pytest.raises(SystemExit):
+            bench._parse_args(["--tiers", "cnn,warpdrive"])
+        assert "warpdrive" in capsys.readouterr().err
+
+    def test_empty_tiers_rejected_not_recorded_as_all(self, capsys):
+        # `--tiers ""` must not silently run nothing while the _meta line
+        # claims a full run was requested
+        with pytest.raises(SystemExit):
+            bench._parse_args(["--tiers", ""])
+        assert "no tier names" in capsys.readouterr().err
+
+    def test_unknown_flag_is_ignored_not_fatal(self, capsys):
+        # the final JSON line must ALWAYS print: a stranger flag from the
+        # archiving driver cannot be allowed to SystemExit before collect()
+        args = bench._parse_args(["--some-future-flag", "--smoke"])
+        assert args.smoke is True
+        assert "ignoring unrecognized" in capsys.readouterr().err
+
+    def test_ambiguous_prefix_is_ignored_not_fatal(self, capsys):
+        # allow_abbrev=False: '--write-b' must fall into the ignored-
+        # leftovers path, not SystemExit(2) inside argparse pre-collect
+        args = bench._parse_args(["--write-b"])
+        assert args.write_baseline is False
+        assert args.write_baseline_from is None
+        assert "ignoring unrecognized" in capsys.readouterr().err
+
+    def test_fallback_subset_metric_does_not_claim_timeout_skips(
+            self, monkeypatch):
+        # fused ran reduced under a --tiers subset: the banner must not
+        # say 'batched/fused10k/conv rungs skipped' for deselected tiers
+        calls = {}
+        _stub_tiers(monkeypatch, calls)
+        r = bench.collect(backend_error="tunnel dead", platform="cpu",
+                          tiers={"fused", "rpc"})
+        assert "CPU FALLBACK" in r["metric"]
+        assert "--tiers subset" in r["metric"]
+        assert "conv rungs skipped" not in r["metric"]
+
+    def test_smoke_ignores_tiers_with_warning(self, capsys):
+        args = bench._parse_args(["--smoke", "--tiers", "pallas"])
+        assert args.tiers is None
+        assert "ignored under --smoke" in capsys.readouterr().err
+
+    def test_fallback_with_fused_deselected_labels_honestly(
+            self, monkeypatch):
+        # the CPU-FALLBACK metric/method must not claim the reduced fused
+        # schedule ran when --tiers excluded it
+        calls = {}
+        _stub_tiers(monkeypatch, calls)
+        r = bench.collect(backend_error="tunnel dead", platform="cpu",
+                          tiers={"teacher"})
+        assert "fused" not in calls
+        assert "deselected by --tiers" in r["metric"]
+        assert "deselected by --tiers" in r["detail"]["method"]
+        assert "REDUCED schedule" not in r["detail"]["method"]
+        assert r["value"] is None
+
+    def test_fallback_with_fused_crashed_blames_the_crash_not_tiers(
+            self, monkeypatch):
+        # full fallback run where the fused tier was ATTEMPTED and died:
+        # the labels must say so, not fabricate a --tiers subset
+        calls = {}
+        _stub_tiers(monkeypatch, calls)
+
+        def boom(*a, **k):
+            raise RuntimeError("device OOM")
+
+        monkeypatch.setattr(bench, "bench_fused", boom)
+        r = bench.collect(backend_error="tunnel dead", platform="cpu")
+        assert "attempted but failed" in r["metric"]
+        assert "attempted but failed" in r["detail"]["method"]
+        assert "--tiers" not in r["metric"]
+        assert "device OOM" in r["error"]["fused"]
+
+    def test_tier_order_covers_all_tier_names(self):
+        # the --tiers vocabulary and the execution order are one constant
+        assert set(bench.TIER_ORDER) == {
+            "cnn", "cnn_wide", "pallas", "resnet", "fused10k",
+            "chunked_compile", "fused", "rpc", "batched", "teacher",
+        }
+
+
+class TestPartialWrites:
+    def test_each_tier_lands_on_disk_as_it_completes(
+            self, monkeypatch, tmp_path):
+        calls = {}
+        _stub_tiers(monkeypatch, calls)
+        p = tmp_path / "partial.jsonl"
+        bench.collect(backend_error=None, platform=None,
+                      tiers={"cnn", "rpc"}, partial_path=str(p))
+        lines = [json.loads(l) for l in p.read_text().splitlines()]
+        assert lines[0]["tier"] == "_meta"
+        assert lines[0]["tiers_requested"] == ["cnn", "rpc"]
+        tiers_written = [l["tier"] for l in lines[1:]]
+        assert tiers_written == ["cnn", "rpc"]  # evidence order, only selected
+        assert all("elapsed_total_s" in l for l in lines[1:])
+
+    def test_meta_line_truncates_stale_file(self, monkeypatch, tmp_path):
+        p = tmp_path / "partial.jsonl"
+        p.write_text('{"tier": "stale-from-last-run"}\n')
+        calls = {}
+        _stub_tiers(monkeypatch, calls)
+        bench.collect(backend_error=None, platform=None, tiers=set(),
+                      partial_path=str(p))
+        lines = p.read_text().splitlines()
+        assert "stale-from-last-run" not in lines[0]
+        assert json.loads(lines[0])["tier"] == "_meta"
+
+    def test_partial_write_failure_does_not_kill_the_run(
+            self, monkeypatch, capsys):
+        calls = {}
+        _stub_tiers(monkeypatch, calls)
+        r = bench.collect(backend_error=None, platform=None,
+                          tiers={"rpc"},
+                          partial_path="/nonexistent-dir/partial.jsonl")
+        assert r["detail"]["tiers"]["rpc_pool_1worker"]["median"] == 11.0
+        assert "partial write" in capsys.readouterr().err
+
+
+class TestCompactLineContract:
+    """The driver captures a 2000-char tail and parses the LAST line;
+    r03/r04's monolithic result line overran it and landed parsed: null
+    despite rc=0 (VERDICT r4 #2). The compact line must fit WHATEVER the
+    run did."""
+
+    def test_worst_case_fits_and_parses(self):
+        r = _modern_result()
+        r["metric"] = ("configs evaluated/sec/chip (CPU FALLBACK: 9 "
+                       "brackets, budgets 1..27; batched/fused10k/conv "
+                       "rungs skipped)")
+        r["unit"] = "configs/s/chip"
+        r["smoke"] = True
+        r["error"] = {
+            t: "E" * 400 for t in list(bench.TIER_ORDER) + ["backend",
+                                                            "collect"]
+        }
+        line = bench.compact_line(r, "BENCH_DETAIL.json")
+        assert len(line) <= bench.COMPACT_LINE_MAX
+        out = json.loads(line)
+        assert out["value"] == 100.0 and out["vs_baseline"] == 10.0
+        assert out["platform"] == "tpu"
+        assert out["detail_file"] == "BENCH_DETAIL.json"
+        assert out["smoke"] is True and "backend" in out["error"]
+
+    def test_measured_tiers_listed_skipped_ones_not(self):
+        r = _modern_result()
+        r["detail"]["tiers"]["batched_parallel_brackets3"] = {
+            "skipped": "not selected (--tiers)"}
+        r["detail"]["cnn_wide_mxu_saturation"] = None
+        line = json.loads(bench.compact_line(r, "D.json"))
+        assert "fused_27_brackets" in line["tiers_measured"]
+        assert "batched_parallel_brackets3" not in line["tiers_measured"]
+        assert "cnn_wide_mxu_saturation" not in line["tiers_measured"]
+
+    def test_collect_crash_result_still_emits(self):
+        r = {"metric": "m", "value": None, "unit": "u", "vs_baseline": None,
+             "error": {"collect": "BOOM " * 200}}
+        out = json.loads(bench.compact_line(r, "D.json"))
+        assert out["platform"] is None and out["tiers_measured"] == []
+        assert len(json.dumps(out)) <= bench.COMPACT_LINE_MAX
+
+    def test_oversized_line_drops_fields_never_truncates_bytes(self):
+        # a sliced JSON string would land parsed: null — the line must
+        # shrink by dropping whole fields, staying valid JSON, and the
+        # honesty labels (metric banner, error, smoke) must outlive the
+        # detail-ish fields that caused the overflow
+        r = _modern_result()
+        r["metric"] = "configs evaluated/sec/chip (CPU FALLBACK: reduced)"
+        r["unit"] = "configs/s/chip"
+        r["smoke"] = True
+        r["error"] = {"backend": "tunnel dead"}
+        line = bench.compact_line(r, "/very/long/path/" + "d" * 3000
+                                  + ".json")
+        assert len(line) <= bench.COMPACT_LINE_MAX
+        out = json.loads(line)  # still parses
+        assert out["value"] == 100.0 and out["vs_baseline"] == 10.0
+        assert "detail_file" not in out  # the culprit went first
+        assert "CPU FALLBACK" in out["metric"]  # honesty survived
+        assert out["smoke"] is True and "tunnel dead" in out["error"]
+
+    def test_failed_detail_write_drops_the_pointer(self, monkeypatch,
+                                                   capsys):
+        # a compact line must never point at a STALE detail file from a
+        # previous run: when this run's write failed, the field goes away
+        monkeypatch.setattr(bench, "_acquire_backend",
+                            lambda: ("cpu", None))
+        monkeypatch.setattr(
+            bench, "collect",
+            lambda **kw: dict(_modern_result(), metric="m", unit="u"))
+        bench.main(["--detail-out", "/nonexistent-dir/D.json",
+                    "--partial-out", ""])
+        cap = capsys.readouterr()
+        out = json.loads(cap.out.strip().splitlines()[-1])
+        assert "detail_file" not in out
+        assert "detail write" in cap.err
+
+    def test_main_prints_compact_line_last(self, monkeypatch, tmp_path,
+                                           capsys):
+        monkeypatch.setattr(bench, "_acquire_backend",
+                            lambda: ("cpu", None))
+        monkeypatch.setattr(
+            bench, "collect",
+            lambda **kw: dict(_modern_result(), metric="m",
+                              unit="configs/s/chip"))
+        detail = tmp_path / "BENCH_DETAIL.json"
+        bench.main(["--detail-out", str(detail), "--partial-out", ""])
+        lines = capsys.readouterr().out.strip().splitlines()
+        out = json.loads(lines[-1])
+        assert len(lines[-1]) <= bench.COMPACT_LINE_MAX
+        assert out["detail_file"] == str(detail)
+        # the detail file holds the FULL result the line only points at
+        full = json.loads(detail.read_text())
+        assert full["detail"]["tiers"]["fused_27_brackets"]["median"] == 100.0
+
+
+class TestLoadArtifact:
+    def test_compact_artifact_resolves_detail_file(self, tmp_path):
+        full = dict(_modern_result(), metric="m", unit="u")
+        (tmp_path / "BENCH_DETAIL.json").write_text(json.dumps(full))
+        art = tmp_path / "BENCH_r05.json"
+        art.write_text(json.dumps({"parsed": {
+            "value": 100.0, "detail_file": "BENCH_DETAIL.json"}}))
+        loaded = bench._load_artifact(str(art))
+        assert loaded["detail"]["chip"] == "TPU v5 lite"
+
+    def test_wrapper_error_flag_survives_detail_resolution(self, tmp_path):
+        (tmp_path / "D.json").write_text(json.dumps(_modern_result()))
+        art = tmp_path / "A.json"
+        art.write_text(json.dumps({"parsed": {
+            "value": 1.0, "detail_file": "D.json",
+            "error": "backend: down"}}))
+        loaded = bench._load_artifact(str(art))
+        assert loaded["error"] == "backend: down"  # refusal still triggers
+
+    def test_missing_detail_file_exits(self, tmp_path, capsys):
+        art = tmp_path / "A.json"
+        art.write_text(json.dumps({"parsed": {
+            "value": 1.0, "detail_file": "GONE.json"}}))
+        with pytest.raises(SystemExit):
+            bench._load_artifact(str(art))
+        assert "GONE.json" in capsys.readouterr().err
+
+    def test_inline_detail_passes_through(self, tmp_path):
+        art = tmp_path / "A.json"
+        art.write_text(json.dumps({"parsed": _modern_result()}))
+        assert bench._load_artifact(str(art))["detail"]["n_chips"] == 1

@@ -871,7 +871,10 @@ class TestFusedSweep:
         def bad(vec, budget):
             return float(vec[0])  # concretizes a tracer
 
-        with pytest.raises(ValueError, match="not traceable"):
+        # the banner names the attempt (abstract evaluation), not a
+        # diagnosis — eval_shape also surfaces plain bugs inside eval_fn,
+        # and "not traceable" would mislabel those (ADVICE r4)
+        with pytest.raises(ValueError, match="failed under abstract"):
             FusedBOHB(
                 configspace=cs, eval_fn=bad, run_id="bad2",
                 min_budget=1, max_budget=9, eta=3, seed=0,
@@ -922,6 +925,54 @@ class TestDynamicCountSweep:
         plans = hyperband_schedule(9, 1, 9, 3)
         assert len(res.get_all_runs()) == sum(sum(p.num_configs) for p in plans)
         assert res.get_incumbent_id() is not None
+
+    def test_oversized_capacities_default_missing_budgets_to_empty(self):
+        # ADVICE r4: a budget present in `capacities` but absent from the
+        # warm inputs must trace as an empty count-0 buffer, not raise a
+        # bare KeyError — exported-API callers may oversize the capacity
+        # map for a later chunk's budgets
+        from hpbandster_tpu.ops.sweep import plan_additions
+
+        cs = branin_space(seed=3)
+        codec = build_space_codec(cs)
+        plans = hyperband_schedule(1, 1, 9, 3)
+        adds = {float(b): int(n) for b, n in plan_additions(plans).items()}
+        caps = dict(adds)
+        caps[27.0] = 8  # extra budget: capacity, but no warm data for it
+        fn = make_fused_sweep_fn(
+            branin_from_vector, plans, codec, dynamic_counts=True,
+            capacities=caps,
+        )
+        d = int(codec.kind.shape[0])
+        warm_v = {b: jnp.zeros((caps[b], d), jnp.float32) for b in adds}
+        warm_l = {b: jnp.full((caps[b],), jnp.inf, jnp.float32) for b in adds}
+        warm_n = {b: jnp.zeros((), jnp.int32) for b in adds}
+        outs = fn(0, warm_v, warm_l, warm_n)
+        assert len(outs) == len(plans)
+        assert np.isfinite(np.asarray(outs[0].loss_packed)).any()
+
+    def test_partially_missing_warm_budget_is_named_not_keyerror(self):
+        # a budget in SOME of the three warm dicts is a caller bug; the
+        # trace must name it instead of raising a bare KeyError from
+        # warm_v[b] (or silently dropping data when only warm_v has it)
+        from hpbandster_tpu.ops.sweep import plan_additions
+
+        cs = branin_space(seed=3)
+        codec = build_space_codec(cs)
+        plans = hyperband_schedule(1, 1, 9, 3)
+        adds = {float(b): int(n) for b, n in plan_additions(plans).items()}
+        fn = make_fused_sweep_fn(
+            branin_from_vector, plans, codec, dynamic_counts=True,
+            capacities=adds,
+        )
+        d = int(codec.kind.shape[0])
+        warm_v = {b: jnp.zeros((adds[b], d), jnp.float32) for b in adds}
+        warm_l = {b: jnp.full((adds[b],), jnp.inf, jnp.float32) for b in adds}
+        warm_n = {b: jnp.zeros((), jnp.int32) for b in adds}
+        victim = sorted(adds)[0]
+        del warm_v[victim]  # in warm_n/warm_l but not warm_v
+        with pytest.raises(ValueError, match="inconsistent warm inputs"):
+            fn(0, warm_v, warm_l, warm_n)
 
     def test_forced_dynamic_matches_sh_arithmetic_and_is_deterministic(self):
         def run_once():
