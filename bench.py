@@ -63,7 +63,7 @@ HEADLINE_BRACKETS = 27
 #: r4 #1a): the MFU ladder and the Pallas policy number have never been
 #: measured on a TPU; the headline fused/rpc pair has (BENCH_r02.json)
 TIER_ORDER = (
-    "cnn", "cnn_wide", "pallas", "resnet", "fused10k",
+    "cnn", "cnn_wide", "pallas", "resnet", "fused10k", "chunked10k",
     "chunked_compile", "fused", "rpc", "batched", "teacher",
 )
 
@@ -543,7 +543,25 @@ def bench_teacher(seed=0):
     return out
 
 
-def bench_chunked_compile(n_iterations=9, chunk=3, max_budget=9, seed=70):
+def bench_chunked_10k(seed=60, on_subresult=None):
+    """Dynamic-count economics AT SCALE (VERDICT r4 next #5): the
+    36-bracket 1..729 schedule — the fused10k program — run chunked
+    (``chunk_brackets=6``), dynamic tier FIRST so a dying tunnel window
+    still keeps the number that has never existed: ``on_subresult`` fires
+    the moment each sub-run finishes (collect() appends it to the partial
+    trail), so the static comparison dying cannot take the finished
+    dynamic dict with it. This is the workload the dynamic tier exists
+    for: compile counts are the cache-independent claim, wall rides
+    along."""
+    return bench_chunked_compile(
+        n_iterations=36, chunk=6, max_budget=729, seed=seed,
+        dynamic_first=True, warmup=False, on_subresult=on_subresult,
+    )
+
+
+def bench_chunked_compile(n_iterations=9, chunk=3, max_budget=9, seed=70,
+                          dynamic_first=False, warmup=True,
+                          on_subresult=None):
     """Chunked-sweep compile economics: static tier (each chunk's
     observation counts burned into its trace -> one fresh compile per
     chunk) vs the dynamic-count tier (traced counts -> executable reuse
@@ -588,22 +606,35 @@ def bench_chunked_compile(n_iterations=9, chunk=3, max_budget=9, seed=70):
             "compile_s_total": round(sum(fresh), 2),
         }
         opt.shutdown()
+        if on_subresult is not None:
+            # land each sub-run on disk the moment it exists: the OTHER
+            # tier dying (tunnel collapse mid-static) must not discard a
+            # finished measurement that took tens of chip-minutes
+            on_subresult("dynamic" if dynamic else "static", out)
         return out
 
-    # warmup: a throwaway 1-bracket run pays backend init and first-ever
-    # XLA pipeline warmup WITHOUT warming the measured executables (its
-    # program differs from both timed schedules), so the static-first
-    # ordering doesn't bill process warmup to the static tier
-    warm = FusedBOHB(
-        configspace=branin_space(seed=seed), eval_fn=branin_from_vector,
-        run_id="bench-cc-warm", min_budget=1, max_budget=max_budget,
-        eta=3, seed=seed, mesh=mesh,
-    )
-    warm.run(n_iterations=1)
-    warm.shutdown()
+    if warmup:
+        # warmup: a throwaway 1-bracket run pays backend init and
+        # first-ever XLA pipeline warmup WITHOUT warming the measured
+        # executables (its program differs from both timed schedules), so
+        # the first-measured ordering doesn't get billed process warmup
+        warm = FusedBOHB(
+            configspace=branin_space(seed=seed), eval_fn=branin_from_vector,
+            run_id="bench-cc-warm", min_budget=1, max_budget=max_budget,
+            eta=3, seed=seed, mesh=mesh,
+        )
+        warm.run(n_iterations=1)
+        warm.shutdown()
 
-    static = run(False)
-    dynamic = run(True)
+    if dynamic_first:
+        # at-scale variant: the dynamic number is the missing one — run
+        # it first (and on_subresult lands it on disk immediately), so a
+        # death during the static comparison cannot cost it
+        dynamic = run(True)
+        static = run(False)
+    else:
+        static = run(False)
+        dynamic = run(True)
     wall = (
         round(static["first_run_wall_s"] / dynamic["first_run_wall_s"], 2)
         if dynamic["first_run_wall_s"] > 0 else None
@@ -726,7 +757,7 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
         fused = emit("fused", scaled_summary(fused_out[0]) if fused_out
                      else None)
         fused10k = batched = cnn = cnn_wide = resnet = teacher = None
-        chunked = None
+        chunked = chunked10k = None
         rpc_rates = _run_tier(errors, "rpc", bench_rpc_baseline,
                               repeats=repeats)
         rpc = emit("rpc", _summary(rpc_rates) if rpc_rates else None)
@@ -792,6 +823,28 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
                 if len(fused10k_out) > 2:
                     fused10k["runs_timing_split"] = fused10k_out[2]
             emit("fused10k", fused10k)
+        if not selected("chunked10k"):
+            chunked10k = dict(NOT_SELECTED)
+        elif backend_error:
+            chunked10k = {
+                "skipped": "TPU unavailable; the at-scale chunked program "
+                           "is the fused10k compile bill twice over on "
+                           "CPU, for numbers only a chip run can cite"
+            }
+        else:
+            sub = (
+                (lambda nm, v: _append_partial(partial_path, {
+                    "tier": "chunked10k.%s" % nm,
+                    "elapsed_total_s": round(
+                        time.perf_counter() - t_start, 1),
+                    "result": v,
+                }))
+                if partial_path else None
+            )
+            chunked10k = emit(
+                "chunked10k",
+                _run_tier(errors, "chunked10k", bench_chunked_10k,
+                          on_subresult=sub))
         chunked = (
             emit("chunked_compile",
                  _run_tier(errors, "chunked_compile", bench_chunked_compile))
@@ -913,6 +966,7 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
             "teacher_workload_budget_epochs": teacher,
             "pallas_scorer_vs_xla": pallas,
             "chunked_compile_static_vs_dynamic": chunked,
+            "chunked10k_at_scale_36_brackets_1_729": chunked10k,
         },
     }
     if smoke:
@@ -1107,6 +1161,26 @@ def write_baseline(result, path="BASELINE.md", source=None):
                  "artifact.",
     ))
     lines.append("")
+    lines.append(render(
+        d.get("chunked10k_at_scale_36_brackets_1_729"),
+        lambda x: (
+            "Chunked AT SCALE (%s; the fused10k program, chunk 6, dynamic "
+            "measured first): %d fresh compiles static vs %d dynamic-count, "
+            "compile %.1f s vs %.1f s, first-run wall %.1f s vs %.1f s "
+            "(wall/compile seconds shrink when the persistent XLA disk "
+            "cache is warm — the compile COUNT is the cache-independent "
+            "claim)."
+            % (x["schedule"], x["static"]["fresh_compiles"],
+               x["dynamic"]["fresh_compiles"],
+               x["static"]["compile_s_total"],
+               x["dynamic"]["compile_s_total"],
+               x["static"]["first_run_wall_s"],
+               x["dynamic"]["first_run_wall_s"])
+        ),
+        fallback="Chunked at 10k scale: not measured in this artifact "
+                 "(pending a chip run).",
+    ))
+    lines.append("")
     with open(path) as f:
         text = f.read()
     cut = text.find(BASELINE_MARK)
@@ -1154,7 +1228,8 @@ def compact_line(result, detail_file):
     for k in ("cnn_workload_budget_sgd_steps", "cnn_wide_mxu_saturation",
               "resnet_workload_budget_sgd_steps",
               "teacher_workload_budget_epochs", "pallas_scorer_vs_xla",
-              "chunked_compile_static_vs_dynamic"):
+              "chunked_compile_static_vs_dynamic",
+              "chunked10k_at_scale_36_brackets_1_729"):
         tiers[k] = d.get(k)
     out["tiers_measured"] = sorted(
         k for k, v in tiers.items()

@@ -132,6 +132,15 @@ def _modern_result():
                 "fresh_compiles_static_vs_dynamic": [3, 1],
                 "first_run_wall_speedup": 2.56,
             },
+            "chunked10k_at_scale_36_brackets_1_729": {
+                "schedule": "36 brackets, chunk 6, budgets 1..729",
+                "static": {"first_run_wall_s": 400.0, "chunks": 6,
+                           "fresh_compiles": 6, "compile_s_total": 360.0},
+                "dynamic": {"first_run_wall_s": 150.0, "chunks": 6,
+                            "fresh_compiles": 2, "compile_s_total": 110.0},
+                "fresh_compiles_static_vs_dynamic": [6, 2],
+                "first_run_wall_speedup": 2.67,
+            },
         },
     }
 
@@ -148,6 +157,8 @@ class TestWriteBaseline:
         assert "Pallas acquisition scorer" in text and "4.00x" in text
         assert "Chunked-sweep compile reuse" in text
         assert "3 fresh compiles static vs 1 dynamic-count" in text
+        assert "Chunked AT SCALE" in text
+        assert "6 fresh compiles static vs 2 dynamic-count" in text
 
     def test_legacy_r02_cnn_schema_renders_what_it_holds(self, tmp_path):
         # the r02-era cnn dict has no device-time split: the rung must show
@@ -292,6 +303,7 @@ class TestFallbackContract:
         # compile-heavy tiers skipped with recorded reasons, never run
         assert "skipped" in d["tiers"]["batched_parallel_brackets3"]
         assert "skipped" in d["tiers"]["fused_10k_scale_36_brackets_1_729"]
+        assert "skipped" in d["chunked10k_at_scale_36_brackets_1_729"]
         for k in ("cnn_workload_budget_sgd_steps", "cnn_wide_mxu_saturation",
                   "resnet_workload_budget_sgd_steps"):
             assert "skipped" in d[k]
@@ -416,7 +428,7 @@ class TestTierSelection:
     def test_tier_order_covers_all_tier_names(self):
         # the --tiers vocabulary and the execution order are one constant
         assert set(bench.TIER_ORDER) == {
-            "cnn", "cnn_wide", "pallas", "resnet", "fused10k",
+            "cnn", "cnn_wide", "pallas", "resnet", "fused10k", "chunked10k",
             "chunked_compile", "fused", "rpc", "batched", "teacher",
         }
 
@@ -446,6 +458,27 @@ class TestPartialWrites:
         lines = p.read_text().splitlines()
         assert "stale-from-last-run" not in lines[0]
         assert json.loads(lines[0])["tier"] == "_meta"
+
+    def test_chunked10k_subruns_land_on_disk_individually(
+            self, monkeypatch, tmp_path):
+        # the dynamic sub-run (tens of chip-minutes) must be on disk
+        # BEFORE the static comparison starts: a death mid-static cannot
+        # discard it
+        calls = {}
+        _stub_tiers(monkeypatch, calls)
+
+        def fake_10k(seed=60, on_subresult=None):
+            on_subresult("dynamic", {"fresh_compiles": 2})
+            raise RuntimeError("tunnel died during the static comparison")
+
+        monkeypatch.setattr(bench, "bench_chunked_10k", fake_10k)
+        p = tmp_path / "partial.jsonl"
+        r = bench.collect(backend_error=None, platform=None,
+                          tiers={"chunked10k"}, partial_path=str(p))
+        lines = [json.loads(l) for l in p.read_text().splitlines()]
+        subs = [l for l in lines if l["tier"] == "chunked10k.dynamic"]
+        assert subs and subs[0]["result"] == {"fresh_compiles": 2}
+        assert "tunnel died" in r["error"]["chunked10k"]
 
     def test_partial_write_failure_does_not_kill_the_run(
             self, monkeypatch, capsys):
