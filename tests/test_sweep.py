@@ -926,6 +926,61 @@ class TestDynamicCountSweep:
         assert len(res.get_all_runs()) == sum(sum(p.num_configs) for p in plans)
         assert res.get_incumbent_id() is not None
 
+    def test_later_chunk_failure_keeps_previous_chunk_replayed(self):
+        # the deferred replay must land even when the NEXT chunk dies
+        # before dispatch (e.g. a bucket-doubling recompile failing):
+        # otherwise a retry would re-execute a chunk whose observations
+        # are already folded into the warm data
+        opt = self._mk(seed=53)
+        orig = opt._sweep_compiled
+        calls = {"n": 0}
+
+        def failing(*a, **k):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("recompile OOM")
+            return orig(*a, **k)
+
+        opt._sweep_compiled = failing
+        with pytest.raises(RuntimeError, match="recompile OOM"):
+            opt.run(n_iterations=9, chunk_brackets=3)
+        # chunk 1's brackets were replayed before the error propagated
+        assert len(opt.iterations) == 3
+        # and a retry continues from bracket 3 with no duplicates
+        opt._sweep_compiled = orig
+        res = opt.run(n_iterations=9, chunk_brackets=3)
+        opt.shutdown()
+        plans = hyperband_schedule(9, 1, 9, 3)
+        assert len(res.get_all_runs()) == sum(
+            sum(p.num_configs) for p in plans
+        )
+        assert len(opt.iterations) == 9
+
+    def test_pipelined_replay_matches_sequential_and_records_overlap(
+            self, tmp_path):
+        # chunk k's host replay runs inside chunk k+1's device window
+        # (replay_overlap_s) UNLESS a checkpoint_path forces sequential
+        # replay; either way the replayed results are identical — replay
+        # content never depends on when it runs
+        def run_once(ckpt):
+            opt = self._mk(seed=47)
+            res = opt.run(n_iterations=9, chunk_brackets=3,
+                          checkpoint_path=ckpt)
+            opt.shutdown()
+            rows = sorted(
+                (r.config_id, r.budget, r.loss) for r in res.get_all_runs()
+            )
+            return rows, opt.run_stats
+
+        piped, piped_stats = run_once(None)
+        seq, seq_stats = run_once(str(tmp_path / "ck.pkl"))
+        assert piped == seq
+        # pipelined: every chunk but the first hides its predecessor's
+        # replay; sequential: no chunk does
+        assert [("replay_overlap_s" in s) for s in piped_stats] == [
+            False, True, True]
+        assert all("replay_overlap_s" not in s for s in seq_stats)
+
     def test_oversized_capacities_default_missing_budgets_to_empty(self):
         # ADVICE r4: a budget present in `capacities` but absent from the
         # warm inputs must trace as an empty count-0 buffer, not raise a
