@@ -63,8 +63,8 @@ HEADLINE_BRACKETS = 27
 #: r4 #1a): the MFU ladder and the Pallas policy number have never been
 #: measured on a TPU; the headline fused/rpc pair has (BENCH_r02.json)
 TIER_ORDER = (
-    "cnn", "cnn_wide", "pallas", "resnet", "fused10k", "chunked10k",
-    "chunked_compile", "fused", "rpc", "batched", "teacher",
+    "cnn", "cnn_wide", "pallas", "resnet", "transformer", "fused10k",
+    "chunked10k", "chunked_compile", "fused", "rpc", "batched", "teacher",
 )
 
 #: per-tier sample size after one warmup run (compile excluded). The driver
@@ -429,6 +429,43 @@ def bench_cnn_wide(seed=0):
     return out
 
 
+def bench_transformer(seed=0, n_iterations=2):
+    """Transformer (attention) sweep rung: the copy task whose second half
+    is predictable only through the attention circuit; budget = SGD steps,
+    MFU accounting as bench_cnn. The documented target is calibrated from
+    a measured 12-draw probe (workloads/transformer.py)."""
+    from hpbandster_tpu.optimizers import FusedBOHB
+    from hpbandster_tpu.workloads.flops import transformer_step_flops
+    from hpbandster_tpu.workloads.transformer import (
+        TRANSFORMER_TARGET_VAL_ACCURACY,
+        TransformerConfig,
+        make_transformer_error_fn,
+        transformer_space,
+    )
+
+    mesh, _ = _mesh_or_none()
+    cfg = TransformerConfig()
+    cs = transformer_space(seed=seed)
+    opt = FusedBOHB(
+        configspace=cs, eval_fn=make_transformer_error_fn(cfg, data_seed=0),
+        run_id="bench-tfm", min_budget=3, max_budget=81, eta=3, seed=seed,
+        mesh=mesh,
+    )
+    t0 = time.perf_counter()
+    res = opt.run(n_iterations=n_iterations)
+    dt = time.perf_counter() - t0
+    out = _fused_sweep_metrics(opt, res, dt, transformer_step_flops(cfg))
+    traj = res.get_incumbent_trajectory()
+    inc_acc = 1.0 - traj["losses"][-1]
+    out.update({
+        "incumbent_val_accuracy": round(float(inc_acc), 4),
+        "target_val_accuracy": TRANSFORMER_TARGET_VAL_ACCURACY,
+        "target_met": bool(inc_acc >= TRANSFORMER_TARGET_VAL_ACCURACY),
+    })
+    opt.shutdown()
+    return out
+
+
 def bench_pallas_scorer(repeats=5):
     """Pallas acquisition scorer vs the XLA path at realistic shapes
     (VERDICT r2 #3): 128 proposals x 64 candidate samples, 256 observations
@@ -757,7 +794,7 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
         fused = emit("fused", scaled_summary(fused_out[0]) if fused_out
                      else None)
         fused10k = batched = cnn = cnn_wide = resnet = teacher = None
-        chunked = chunked10k = None
+        chunked = chunked10k = transformer = None
         rpc_rates = _run_tier(errors, "rpc", bench_rpc_baseline,
                               repeats=repeats)
         rpc = emit("rpc", _summary(rpc_rates) if rpc_rates else None)
@@ -803,6 +840,18 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
             resnet = dict(skip_conv)
         else:
             resnet = emit("resnet", _run_tier(errors, "resnet", bench_resnet))
+        if not selected("transformer"):
+            transformer = dict(NOT_SELECTED)
+        elif backend_error:
+            transformer = {
+                "skipped": "TPU unavailable; the attention rung costs "
+                           "tens of CPU-minutes (timeout risk) for "
+                           "numbers the fallback artifact cannot cite"
+            }
+        else:
+            transformer = emit(
+                "transformer",
+                _run_tier(errors, "transformer", bench_transformer))
         if not selected("fused10k"):
             fused10k = dict(NOT_SELECTED)
         elif backend_error:
@@ -963,6 +1012,7 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
             "cnn_workload_budget_sgd_steps": cnn,
             "cnn_wide_mxu_saturation": cnn_wide,
             "resnet_workload_budget_sgd_steps": resnet,
+            "transformer_workload_budget_sgd_steps": transformer,
             "teacher_workload_budget_epochs": teacher,
             "pallas_scorer_vs_xla": pallas,
             "chunked_compile_static_vs_dynamic": chunked,
@@ -1035,6 +1085,7 @@ def write_baseline(result, path="BASELINE.md", source=None):
     cnn = d.get("cnn_workload_budget_sgd_steps")
     wide = d.get("cnn_wide_mxu_saturation")
     resnet = d.get("resnet_workload_budget_sgd_steps")
+    tfm = d.get("transformer_workload_budget_sgd_steps")
     teacher = d.get("teacher_workload_budget_epochs")
     pallas = d.get("pallas_scorer_vs_xla")
 
@@ -1118,6 +1169,18 @@ def write_baseline(result, path="BASELINE.md", source=None):
         ),
         fallback="| ResNet-18 sweep (2 brackets, 3..27) | — | — | — | — | "
                  "not measured in this artifact |",
+    ))
+    lines.append(render(
+        tfm,
+        lambda x: (
+            "| Transformer copy sweep (2 brackets, 3..81) | %d | %s | %s "
+            "| %s | incumbent val acc %.3f vs target %.2f (met: %s) |"
+            % (x["evaluations"], x["device_execute_s"], tflops(x), mfu(x),
+               x["incumbent_val_accuracy"], x["target_val_accuracy"],
+               x["target_met"])
+        ),
+        fallback="| Transformer copy sweep (2 brackets, 3..81) | — | — | — "
+                 "| — | not measured in this artifact |",
     ))
     lines.append("")
     lines.append(render(
@@ -1227,6 +1290,7 @@ def compact_line(result, detail_file):
     tiers = dict(d.get("tiers") or {})
     for k in ("cnn_workload_budget_sgd_steps", "cnn_wide_mxu_saturation",
               "resnet_workload_budget_sgd_steps",
+              "transformer_workload_budget_sgd_steps",
               "teacher_workload_budget_epochs", "pallas_scorer_vs_xla",
               "chunked_compile_static_vs_dynamic",
               "chunked10k_at_scale_36_brackets_1_729"):

@@ -40,6 +40,16 @@ from hpbandster_tpu.workloads.mlp import (  # noqa: F401
     mlp_forward,
     mlp_space,
 )
+from hpbandster_tpu.workloads.transformer import (  # noqa: F401
+    TRANSFORMER_TARGET_VAL_ACCURACY,
+    TransformerConfig,
+    make_copy_dataset,
+    make_transformer_accuracy_fn,
+    make_transformer_error_fn,
+    make_transformer_eval_fn,
+    transformer_forward,
+    transformer_space,
+)
 from hpbandster_tpu.workloads.teacher import (  # noqa: F401
     TARGET_VAL_ACCURACY,
     TeacherConfig,

@@ -25,6 +25,7 @@ from hpbandster_tpu.workloads.cnn import CNNConfig
 from hpbandster_tpu.workloads.mlp import MLPConfig
 from hpbandster_tpu.workloads.resnet import ResNetConfig
 from hpbandster_tpu.workloads.teacher import TeacherConfig, _student_cfg
+from hpbandster_tpu.workloads.transformer import TransformerConfig
 
 __all__ = [
     "mlp_forward_flops",
@@ -35,6 +36,8 @@ __all__ = [
     "cnn_step_flops",
     "resnet_forward_flops",
     "resnet_step_flops",
+    "transformer_forward_flops",
+    "transformer_step_flops",
     "peak_bf16_flops",
     "sweep_training_flops",
 ]
@@ -144,6 +147,31 @@ def resnet_forward_flops(cfg: ResNetConfig, batch: int) -> float:
 def resnet_step_flops(cfg: ResNetConfig = ResNetConfig()) -> float:
     batch = min(cfg.batch_size, cfg.n_train)
     return 3.0 * resnet_forward_flops(cfg, batch)
+
+
+# ----------------------------------------------------------- transformer
+def transformer_forward_flops(cfg: TransformerConfig, batch: int) -> float:
+    """One forward pass of ``transformer_forward`` over a batch: per layer
+    QKV/out projections (4 GEMMs), attention scores + mixing (2 T x T
+    GEMMs across heads), the 2-GEMM MLP; plus the vocabulary head.
+    Embedding/positional lookups are gathers, not MXU work (excluded by
+    the module convention)."""
+    t = cfg.seq_len - 1
+    d = cfg.d_model
+    per_layer = (
+        4 * _dense(t, d, d)          # wq, wk, wv, wo
+        + 2 * 2.0 * t * t * d        # scores q@k^T + mixing att@v
+        + _dense(t, d, cfg.d_ff)     # mlp up
+        + _dense(t, cfg.d_ff, d)     # mlp down
+    )
+    head = _dense(t, d, cfg.vocab + 1)
+    return batch * (cfg.n_layers * per_layer + head)
+
+
+def transformer_step_flops(
+        cfg: TransformerConfig = TransformerConfig()) -> float:
+    batch = min(cfg.batch_size, cfg.n_train)
+    return 3.0 * transformer_forward_flops(cfg, batch)
 
 
 # ------------------------------------------------------------- aggregation

@@ -115,6 +115,12 @@ def _modern_result():
                 "evaluations": 3, "device_execute_s": 3.0,
                 "incumbent_found": True,
             },
+            "transformer_workload_budget_sgd_steps": {
+                "evaluations": 12, "device_execute_s": 2.5,
+                "achieved_flops_per_s": 3e12, "mfu": 0.4,
+                "incumbent_val_accuracy": 0.91, "target_val_accuracy": 0.8,
+                "target_met": True,
+            },
             "teacher_workload_budget_epochs": {
                 "target_val_accuracy": 0.9, "best_val_accuracy": 0.92,
                 "evaluations": 60, "seconds_to_target_incl_compile": 3.5,
@@ -274,6 +280,7 @@ def _stub_tiers(monkeypatch, calls):
                         lambda **kw: calls.setdefault("cnn", True) and {})
     monkeypatch.setattr(bench, "bench_cnn_wide", lambda **kw: {})
     monkeypatch.setattr(bench, "bench_resnet", lambda **kw: {})
+    monkeypatch.setattr(bench, "bench_transformer", lambda **kw: {})
     monkeypatch.setattr(bench, "bench_teacher", lambda **kw: {"t": 1})
     monkeypatch.setattr(bench, "bench_pallas_scorer",
                         lambda **kw: {"pallas_speedup": 2.0})
@@ -305,7 +312,8 @@ class TestFallbackContract:
         assert "skipped" in d["tiers"]["fused_10k_scale_36_brackets_1_729"]
         assert "skipped" in d["chunked10k_at_scale_36_brackets_1_729"]
         for k in ("cnn_workload_budget_sgd_steps", "cnn_wide_mxu_saturation",
-                  "resnet_workload_budget_sgd_steps"):
+                  "resnet_workload_budget_sgd_steps",
+                  "transformer_workload_budget_sgd_steps"):
             assert "skipped" in d[k]
         assert "batched" not in calls and "cnn" not in calls
         # cheap informative tiers still measured; the error rides along
@@ -428,8 +436,9 @@ class TestTierSelection:
     def test_tier_order_covers_all_tier_names(self):
         # the --tiers vocabulary and the execution order are one constant
         assert set(bench.TIER_ORDER) == {
-            "cnn", "cnn_wide", "pallas", "resnet", "fused10k", "chunked10k",
-            "chunked_compile", "fused", "rpc", "batched", "teacher",
+            "cnn", "cnn_wide", "pallas", "resnet", "transformer",
+            "fused10k", "chunked10k", "chunked_compile", "fused", "rpc",
+            "batched", "teacher",
         }
 
 

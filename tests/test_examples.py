@@ -127,3 +127,13 @@ def test_example_10_multihost_fused_spmd():
     # asserts cross-rank run-record agreement internally
     out = run_example("example_10_multihost_fused_spmd.py", timeout=600)
     assert "SPMD OK" in out
+
+
+@pytest.mark.slow
+def test_example_11_transformer_fused():
+    out = run_example(
+        "example_11_transformer_fused.py", "--tiny",
+        "--n_iterations", "2", "--min_budget", "9", "--max_budget", "81",
+    )
+    assert "configs/s" in out
+    assert "copied-half val accuracy" in out

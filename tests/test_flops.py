@@ -22,6 +22,7 @@ from hpbandster_tpu.workloads.flops import (
     resnet_step_flops,
     sweep_training_flops,
     teacher_epoch_flops,
+    transformer_step_flops,
 )
 
 RATIO_LO, RATIO_HI = 0.80, 1.45
@@ -93,6 +94,28 @@ class TestStepFlopsVsXLA:
         fwd = lambda p, xb: resnet_forward(p, xb, cfg.groups)  # noqa: E731
         xla = _xla_flops(_sgd_step(fwd, _xent), params, x, y)
         ratio = xla / resnet_step_flops(cfg._replace(batch_size=32))
+        assert RATIO_LO < ratio < RATIO_HI, ratio
+
+    def test_transformer(self):
+        from hpbandster_tpu.workloads.transformer import (
+            TransformerConfig,
+            _masked_xent,
+            init_transformer_params,
+        )
+
+        cfg = TransformerConfig(batch_size=32, n_train=32)
+        params = init_transformer_params(jax.random.key(0), cfg, 1.0)
+        t = cfg.seq_len - 1
+        x = jnp.zeros((32, t), jnp.int32)
+        y = jnp.zeros((32, t), jnp.int32)
+        mask = jnp.ones((t,), jnp.float32)
+
+        def step(params, x, y):
+            g = jax.grad(lambda p: _masked_xent(p, x, y, cfg, mask))(params)
+            return jax.tree.map(lambda p, gi: p - 0.1 * gi, params, g)
+
+        xla = _xla_flops(step, params, x, y)
+        ratio = xla / transformer_step_flops(cfg)
         assert RATIO_LO < ratio < RATIO_HI, ratio
 
     def test_forward_only_is_one_third(self):
