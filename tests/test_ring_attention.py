@@ -1,0 +1,113 @@
+"""Ring attention vs dense attention — exact parity on the 8-device mesh.
+
+The sequence axis shards across the virtual 'seq' ring; K/V blocks rotate
+via ppermute with online-softmax accumulation. The result must equal
+dense full-sequence attention (not approximate it): f32 compute is pinned
+tight, the bf16 MXU path within bf16 rounding.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hpbandster_tpu.ops.ring_attention import (
+    make_ring_attention,
+    ring_attention_block,
+    seq_mesh,
+)
+
+T, H, DH = 64, 4, 16
+
+
+def _qkv(key, t=T):
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (t, H, DH)
+    return (jax.random.normal(kq, shape, jnp.float32),
+            jax.random.normal(kk, shape, jnp.float32),
+            jax.random.normal(kv, shape, jnp.float32))
+
+
+def dense_attention(q, k, v, causal):
+    s = jnp.einsum("qhd,khd->hqk", q, k) * (DH ** -0.5)
+    if causal:
+        t = q.shape[0]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None], s, -1e30)
+    a = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("hqk,khd->hqd", a, v).transpose(1, 0, 2)
+
+
+class TestRingAttentionParity:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_f32_matches_dense_exactly(self, causal):
+        q, k, v = _qkv(jax.random.key(0))
+        ring = make_ring_attention(
+            seq_mesh(), causal=causal, compute_dtype=jnp.float32
+        )
+        out = jax.jit(ring)(q, k, v)
+        ref = dense_attention(q, k, v, causal)
+        assert out.shape == (T, H, DH)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_bf16_mxu_path_within_rounding(self):
+        q, k, v = _qkv(jax.random.key(1))
+        ring = make_ring_attention(seq_mesh(), compute_dtype=jnp.bfloat16)
+        out = jax.jit(ring)(q, k, v)
+        ref = dense_attention(q, k, v, True)
+        # bf16 has ~8 mantissa bits; attention outputs are O(1)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=5e-2, rtol=5e-2
+        )
+
+    def test_single_device_ring_degenerates_to_local(self):
+        q, k, v = _qkv(jax.random.key(2), t=16)
+        mesh = seq_mesh(jax.devices()[:1])
+        ring = make_ring_attention(mesh, compute_dtype=jnp.float32)
+        ref = dense_attention(q, k, v, True)
+        np.testing.assert_allclose(
+            np.asarray(jax.jit(ring)(q, k, v)), np.asarray(ref),
+            atol=2e-5, rtol=2e-5,
+        )
+
+    def test_differentiable(self):
+        # training through ring attention is the point of seq parallelism
+        q, k, v = _qkv(jax.random.key(3))
+        ring = make_ring_attention(seq_mesh(), compute_dtype=jnp.float32)
+
+        def loss(q):
+            return (ring(q, k, v) ** 2).mean()
+
+        g = jax.jit(jax.grad(loss))(q)
+        assert g.shape == q.shape
+        assert np.isfinite(np.asarray(g)).all()
+        # grads must match the dense formulation too
+        g_ref = jax.grad(lambda q: (dense_attention(q, k, v, True) ** 2)
+                         .mean())(q)
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(g_ref), atol=2e-5, rtol=2e-4
+        )
+
+    def test_composes_inside_user_shard_map(self):
+        # ring_attention_block is usable inside an existing shard_map —
+        # the composition seam for mixing seq parallelism with other axes
+        from jax.sharding import PartitionSpec
+        shard_map = getattr(jax, "shard_map", None)
+        if shard_map is None:
+            from jax.experimental.shard_map import shard_map
+
+        q, k, v = _qkv(jax.random.key(4))
+        mesh = seq_mesh()
+        spec = PartitionSpec("seq", None, None)
+        out = jax.jit(shard_map(
+            lambda qb, kb, vb: ring_attention_block(
+                qb, kb, vb, "seq", compute_dtype=jnp.float32
+            ),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        ))(q, k, v)
+        ref = dense_attention(q, k, v, True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
