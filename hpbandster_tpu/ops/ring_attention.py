@@ -7,15 +7,32 @@ axis across the mesh and rotating K/V blocks around the ring with
 ``jax.lax.ppermute`` while queries stay resident. Each of the P steps
 combines one (Q-block, K/V-block) tile with the numerically stable online
 softmax (flash-attention-style running max / normalizer / accumulator),
-so memory per device is O(T/P · d) while the result is bit-for-bit the
-softmax over the FULL sequence — no approximation, no quadratic-in-T
-buffer anywhere.
+so memory per device is O(T/P · d) while the result is the MATHEMATICALLY
+EXACT softmax over the full sequence (no approximation; last-ulp rounding
+differs from dense attention because the reduction is reordered), with no
+quadratic-in-T buffer anywhere.
+
+Causal runs skip the GEMMs of fully-masked tiles (``lax.cond`` on the
+block order). On a synchronous ring this saves energy, not wall — at
+step t the busiest device still computes one live tile, so lockstep wall
+is unchanged; the known fix is striped/zigzag block ordering that load-
+balances live tiles across devices (left documented, not implemented).
+
+The memory bound holds for TRAINING too: a ``custom_vjp`` saves only this
+device's blocks plus the per-row logsumexp and re-ROTATES K/V around the
+ring in the backward pass (flash-attention backward per tile, with the
+dK/dV accumulators traveling alongside their blocks until they return
+home) — without it, reverse-mode AD through the forward loop would stash
+every rotated block as a scan residual and quietly materialize the full
+sequence's K/V per device per layer, exactly what ring attention exists
+to avoid.
 
 TPU mapping: the tile products are bf16 GEMMs with f32 accumulation on
-the MXU (``compute_dtype``); the P-1 ppermutes ride the ICI ring, and XLA
-overlaps each block's GEMM with the next block's transfer — the classic
-compute/communication pipeline of Liu et al.'s ring attention, expressed
-in pure ``shard_map`` + collectives rather than hand-written RDMA.
+the MXU (``compute_dtype``); the P-1 forward (P backward) ppermutes ride
+the ICI ring, and XLA overlaps each block's GEMM with the next block's
+transfer — the compute/communication pipeline of Liu et al.'s ring
+attention, expressed in pure ``shard_map`` + collectives rather than
+hand-written RDMA.
 
 Public surface:
 
@@ -23,20 +40,30 @@ Public surface:
   INSIDE an existing ``shard_map`` (composes with other parallelism).
 * :func:`make_ring_attention` — wraps it in ``shard_map`` over a named
   mesh axis: ``fn(q, k, v)`` on global ``[T, H, dh]`` arrays.
+* ``shard_map`` — the version-resolved transform, re-exported so callers
+  don't repeat the pre-0.8 fallback.
 
-Parity with dense attention is pinned in ``tests/test_ring_attention.py``
-on the virtual 8-device mesh (causal and full, f32 exact and bf16).
+Parity with dense attention — values AND gradients — is pinned in
+``tests/test_ring_attention.py`` on the virtual 8-device mesh.
 """
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
 
-__all__ = ["ring_attention_block", "make_ring_attention", "seq_mesh"]
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+__all__ = [
+    "ring_attention_block", "make_ring_attention", "seq_mesh", "shard_map",
+]
 
 #: additive mask value: large-negative (not -inf) so fully-masked tiles
 #: produce exp() underflow to exactly 0 instead of NaN arithmetic
@@ -45,59 +72,50 @@ _MASK = -1e30
 
 def seq_mesh(devices=None) -> Mesh:
     """1-D mesh over all devices with a 'seq' axis (the long-context twin
-    of ``parallel.config_mesh``)."""
-    import numpy as np
+    of — and delegate to — ``parallel.mesh.config_mesh``)."""
+    from hpbandster_tpu.parallel.mesh import config_mesh
 
-    devices = list(devices if devices is not None else jax.devices())
-    return Mesh(np.asarray(devices), axis_names=("seq",))
+    return config_mesh(devices, axis_name="seq")
 
 
-def ring_attention_block(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    axis_name: str,
-    *,
-    causal: bool = True,
-    scale: Optional[float] = None,
-    compute_dtype=jnp.bfloat16,
-) -> jax.Array:
-    """Exact attention for this device's query block; call inside shard_map.
+def _ring_perm(p_size):
+    return [(s, (s + 1) % p_size) for s in range(p_size)]
 
-    ``q``/``k``/``v``: this shard's blocks, ``[T_blk, H, dh]`` (the global
-    sequence is the concatenation over the ``axis_name`` ring, in axis
-    order). Causal masking uses GLOBAL positions, so the result equals
-    dense causal attention over the full sequence.
 
-    The loop runs P = mesh-axis-size steps; step t processes the K/V
-    block that originated on device ``(i - t) mod P`` and then rotates
-    K/V one hop around the ring. Scores/mixing are ``compute_dtype``
-    GEMMs with f32 accumulation; the running (max, normalizer,
-    accumulator) state is f32.
-    """
+def _tile_scores(q_c, k_blk, scale, compute_dtype, causal, q_pos, j, t_k):
+    """[H, Tq, Tk] tile scores: compute_dtype GEMM, f32 accumulation,
+    global-position causal mask."""
+    s = jnp.einsum(
+        "qhd,khd->hqk", q_c, k_blk.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if causal:
+        k_pos = j * t_k + jnp.arange(t_k)
+        s = jnp.where((q_pos[:, None] >= k_pos[None, :])[None], s, _MASK)
+    return s
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _ring_attention(axis_name, causal, scale, compute_dtype, q, k, v):
+    out, _ = _ring_attention_fwd(axis_name, causal, scale, compute_dtype,
+                                 q, k, v)
+    return out
+
+
+def _ring_attention_fwd(axis_name, causal, scale, compute_dtype, q, k, v):
     p_size = jax.lax.psum(1, axis_name)
     i = jax.lax.axis_index(axis_name)
     t_q, n_heads, dh = q.shape
     t_k = k.shape[0]
-    scale = dh ** -0.5 if scale is None else scale
-
     q_c = q.astype(compute_dtype)
     q_pos = i * t_q + jnp.arange(t_q)
-    perm = [(s, (s + 1) % p_size) for s in range(p_size)]
+    perm = _ring_perm(p_size)
 
     def tile_update(j, k_blk, v_blk, m, l, acc):
         """Fold one (Q-block, K/V-block-from-device-j) tile into the
         running online-softmax state."""
-        # [H, Tq, Tk] tile scores: compute_dtype GEMM, f32 accumulation
-        s = jnp.einsum(
-            "qhd,khd->hqk", q_c, k_blk.astype(compute_dtype),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        if causal:
-            k_pos = j * t_k + jnp.arange(t_k)
-            s = jnp.where(
-                (q_pos[:, None] >= k_pos[None, :])[None], s, _MASK
-            )
+        s = _tile_scores(q_c, k_blk, scale, compute_dtype, causal,
+                         q_pos, j, t_k)
         m_new = jnp.maximum(m, s.max(axis=-1))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[..., None])
@@ -127,14 +145,139 @@ def ring_attention_block(
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         j = (i - t) % p_size  # ring origin after t rotations
-        m, l, acc = tile_update(j, k_blk, v_blk, m, l, acc)
+        if causal:
+            # a tile whose every key position exceeds every query position
+            # is fully masked: its probabilities are exactly 0, so skip
+            # its GEMMs (under vmap cond lowers to select and computes
+            # both — harmless, just no saving)
+            m, l, acc = jax.lax.cond(
+                j * t_k > i * t_q + (t_q - 1),
+                lambda: (m, l, acc),
+                lambda: tile_update(j, k_blk, v_blk, m, l, acc),
+            )
+        else:
+            m, l, acc = tile_update(j, k_blk, v_blk, m, l, acc)
         return k_blk, v_blk, m, l, acc
 
-    _, _, _, l, acc = jax.lax.fori_loop(
+    _, _, m, l, acc = jax.lax.fori_loop(
         1, p_size, body, (k, v, m, l, acc)
     )
-    out = acc / l[..., None]
-    return out.transpose(1, 0, 2).astype(q.dtype)
+    out_hqd = acc / l[..., None]
+    out = out_hqd.transpose(1, 0, 2).astype(q.dtype)
+    # residuals are O(T/P · d): own blocks + per-row logsumexp. The
+    # rotated blocks are NOT saved — the backward re-rotates them.
+    logsumexp = m + jnp.log(l)
+    return out, (q, k, v, out, logsumexp)
+
+
+def _ring_attention_bwd(axis_name, causal, scale, compute_dtype, res, dout):
+    """Flash-attention backward per tile, K/V re-rotated around the ring.
+
+    With the saved logsumexp L the softmax probabilities of any tile are
+    recomputable exactly (``p = exp(s - L)``); the dK/dV accumulators
+    travel WITH their blocks so after P-1 in-loop rotations plus one
+    final hop every block's gradient lands back on its home device.
+    """
+    q, k, v, out, logsumexp = res
+    p_size = jax.lax.psum(1, axis_name)
+    i = jax.lax.axis_index(axis_name)
+    t_q, n_heads, dh = q.shape
+    t_k = k.shape[0]
+    q_c = q.astype(compute_dtype)
+    q_pos = i * t_q + jnp.arange(t_q)
+    perm = _ring_perm(p_size)
+
+    do_f = dout.astype(jnp.float32)
+    # D = rowsum(dO ∘ O): the softmax-jacobian correction term, [H, Tq]
+    d_corr = jnp.einsum("qhd,qhd->hq", do_f, out.astype(jnp.float32))
+    do_c = dout.astype(compute_dtype)
+
+    def tile_grads(j, k_blk, v_blk, dk_blk, dv_blk, dq):
+        s = _tile_scores(q_c, k_blk, scale, compute_dtype, causal,
+                         q_pos, j, t_k)
+        # exact probabilities; masked entries underflow to exactly 0, so
+        # no explicit backward mask is needed
+        p = jnp.exp(s - logsumexp[..., None])
+        p_c = p.astype(compute_dtype)
+        dv_blk = dv_blk + jnp.einsum(
+            "hqk,qhd->khd", p_c, do_c, preferred_element_type=jnp.float32
+        )
+        dp = jnp.einsum(
+            "qhd,khd->hqk", do_c, v_blk.astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - d_corr[..., None])).astype(compute_dtype)
+        dq = dq + scale * jnp.einsum(
+            "hqk,khd->qhd", ds, k_blk.astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        )
+        dk_blk = dk_blk + scale * jnp.einsum(
+            "hqk,qhd->khd", ds, q_c, preferred_element_type=jnp.float32
+        )
+        return dk_blk, dv_blk, dq
+
+    dk0 = jnp.zeros((t_k, n_heads, dh), jnp.float32)
+    dv0 = jnp.zeros((t_k, n_heads, dh), jnp.float32)
+    dq0 = jnp.zeros((t_q, n_heads, dh), jnp.float32)
+    dk_blk, dv_blk, dq = tile_grads(i, k, v, dk0, dv0, dq0)
+
+    def body(t, carry):
+        k_blk, v_blk, dk_blk, dv_blk, dq = carry
+        # the gradient accumulators rotate WITH their blocks
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        dk_blk = jax.lax.ppermute(dk_blk, axis_name, perm)
+        dv_blk = jax.lax.ppermute(dv_blk, axis_name, perm)
+        j = (i - t) % p_size
+        if causal:
+            # fully-masked tile: p == 0 everywhere, all its gradient
+            # contributions are exactly 0 — skip the four GEMMs
+            dk_blk, dv_blk, dq = jax.lax.cond(
+                j * t_k > i * t_q + (t_q - 1),
+                lambda: (dk_blk, dv_blk, dq),
+                lambda: tile_grads(j, k_blk, v_blk, dk_blk, dv_blk, dq),
+            )
+        else:
+            dk_blk, dv_blk, dq = tile_grads(
+                j, k_blk, v_blk, dk_blk, dv_blk, dq
+            )
+        return k_blk, v_blk, dk_blk, dv_blk, dq
+
+    _, _, dk_blk, dv_blk, dq = jax.lax.fori_loop(
+        1, p_size, body, (k, v, dk_blk, dv_blk, dq)
+    )
+    # after P-1 in-loop rotations the accumulators hold block (i+1)'s
+    # gradients; one final hop returns every block home (identity at P=1)
+    dk_blk = jax.lax.ppermute(dk_blk, axis_name, perm)
+    dv_blk = jax.lax.ppermute(dv_blk, axis_name, perm)
+    return dq.astype(q.dtype), dk_blk.astype(k.dtype), dv_blk.astype(v.dtype)
+
+
+_ring_attention.defvjp(_ring_attention_fwd, _ring_attention_bwd)
+
+
+def ring_attention_block(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    compute_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Exact attention for this device's query block; call inside shard_map.
+
+    ``q``/``k``/``v``: this shard's blocks, ``[T_blk, H, dh]`` (the global
+    sequence is the concatenation over the ``axis_name`` ring, in axis
+    order). Causal masking uses GLOBAL positions, so the result equals
+    dense causal attention over the full sequence — and so do its
+    gradients (the custom VJP re-rotates K/V instead of saving residuals,
+    keeping training memory at O(T/P · d) per device).
+    """
+    scale = float(q.shape[-1] ** -0.5 if scale is None else scale)
+    return _ring_attention(axis_name, bool(causal), scale, compute_dtype,
+                           q, k, v)
 
 
 def make_ring_attention(
@@ -151,10 +294,6 @@ def make_ring_attention(
     T must divide evenly by the axis size (shard_map's partitioning
     contract — pad the sequence to a multiple, the standard TPU practice
     for static shapes)."""
-    shard_map = getattr(jax, "shard_map", None)
-    if shard_map is None:  # pre-0.8 jax
-        from jax.experimental.shard_map import shard_map
-
     spec = PartitionSpec(axis, None, None)
 
     def fn(q, k, v):

@@ -38,10 +38,13 @@ def is_multiprocess_mesh(mesh: Optional[Mesh]) -> bool:
     )
 
 
-def config_mesh(devices: Optional[Sequence] = None) -> Mesh:
-    """1-D mesh over all devices: every device evaluates a config shard."""
+def config_mesh(devices: Optional[Sequence] = None,
+                axis_name: str = "config") -> Mesh:
+    """1-D mesh over all devices. The default 'config' axis shards the
+    config batch; ``ops.ring_attention.seq_mesh`` reuses this with a
+    'seq' axis for the long-context path."""
     devices = list(devices if devices is not None else jax.devices())
-    return Mesh(np.asarray(devices), axis_names=("config",))
+    return Mesh(np.asarray(devices), axis_names=(axis_name,))
 
 
 def config_model_mesh(

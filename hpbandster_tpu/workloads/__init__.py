@@ -48,6 +48,7 @@ from hpbandster_tpu.workloads.transformer import (  # noqa: F401
     make_transformer_error_fn,
     make_transformer_eval_fn,
     transformer_forward,
+    transformer_forward_seq_parallel,
     transformer_space,
 )
 from hpbandster_tpu.workloads.teacher import (  # noqa: F401

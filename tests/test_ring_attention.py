@@ -94,9 +94,8 @@ class TestRingAttentionParity:
         # ring_attention_block is usable inside an existing shard_map —
         # the composition seam for mixing seq parallelism with other axes
         from jax.sharding import PartitionSpec
-        shard_map = getattr(jax, "shard_map", None)
-        if shard_map is None:
-            from jax.experimental.shard_map import shard_map
+
+        from hpbandster_tpu.ops.ring_attention import shard_map
 
         q, k, v = _qkv(jax.random.key(4))
         mesh = seq_mesh()

@@ -108,6 +108,89 @@ class TestTransformerWorkload:
         np.testing.assert_allclose(float(err), 1.0 - float(va), atol=1e-6)
 
 
+class TestSeqParallelForward:
+    def test_ring_forward_matches_local_forward(self):
+        # the long-context path: sequence sharded over the 8-device ring,
+        # attention computed via ppermute rotation — logits must match
+        # the single-device forward within bf16 matmul rounding
+        from jax.sharding import PartitionSpec
+
+        from hpbandster_tpu.ops.ring_attention import seq_mesh, shard_map
+        from hpbandster_tpu.workloads.transformer import (
+            transformer_forward_seq_parallel,
+        )
+
+        # tokens length is seq_len - 1 = 2 * prefix_len; prefix 8 gives 16,
+        # divisible by the 8-device ring (shard_map's contract)
+        cfg = TINY._replace(prefix_len=8)
+        params = init_transformer_params(jax.random.key(0), cfg, 1.0)
+        (xt, _), _, _ = make_copy_dataset(jax.random.key(1), cfg)
+        tokens = xt[0]
+        assert tokens.shape[0] % 8 == 0
+
+        mesh = seq_mesh()
+        rep = PartitionSpec()
+        seq = PartitionSpec("seq")
+        ring_logits = jax.jit(shard_map(
+            lambda p, t: transformer_forward_seq_parallel(p, t, cfg, "seq"),
+            mesh=mesh,
+            in_specs=(rep, seq),
+            out_specs=seq,
+        ))(params, tokens)
+        local_logits = transformer_forward(params, tokens, cfg)
+        assert ring_logits.shape == local_logits.shape
+        np.testing.assert_allclose(
+            np.asarray(ring_logits), np.asarray(local_logits),
+            atol=5e-2, rtol=5e-2,
+        )
+
+    def test_ring_forward_grads_match_local(self):
+        # TRAINING through the seq-parallel path: param gradients must
+        # match the local forward's — this covers the ring custom_vjp per
+        # layer, the global-position gathers, AND the shard_map transpose
+        # psum-ing replicated-param cotangents (a dropped psum would train
+        # silently wrong while the forward parity test stayed green)
+        from jax.sharding import PartitionSpec
+
+        from hpbandster_tpu.ops.ring_attention import seq_mesh, shard_map
+        from hpbandster_tpu.workloads.transformer import (
+            transformer_forward_seq_parallel,
+        )
+
+        cfg = TINY._replace(prefix_len=8)
+        params = init_transformer_params(jax.random.key(0), cfg, 1.0)
+        (xt, _), _, _ = make_copy_dataset(jax.random.key(1), cfg)
+        tokens = xt[0]
+        mesh = seq_mesh()
+        ring_fwd = shard_map(
+            lambda p, t: transformer_forward_seq_parallel(p, t, cfg, "seq"),
+            mesh=mesh,
+            in_specs=(PartitionSpec(), PartitionSpec("seq")),
+            out_specs=PartitionSpec("seq"),
+        )
+        g_ring = jax.jit(jax.grad(lambda p: (ring_fwd(p, tokens) ** 2)
+                                  .mean()))(params)
+        g_local = jax.grad(
+            lambda p: (transformer_forward(p, tokens, cfg) ** 2).mean()
+        )(params)
+        def assert_close(a, b, name):
+            # both paths run bf16 attention GEMMs whose rounding differs
+            # (reordered reductions), so a few elements drift at the 1e-1
+            # level on near-cancelling sums. A STRUCTURAL error — dropped
+            # psum on replicated-param cotangents (grads scaled ~1/P or
+            # one shard's worth), wrong positions, a dead layer — moves
+            # the whole tensor, so pin the relative norm of the
+            # difference instead of elementwise tolerance.
+            a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+            rel = np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-6)
+            assert rel < 0.05, f"{name}: relative grad error {rel:.3f}"
+
+        for name in ("tok_emb", "pos_emb", "head", "ln_f"):
+            assert_close(g_ring[name], g_local[name], name)
+        for key in ("wq", "wk", "wv", "wo", "w1", "w2"):
+            assert_close(g_ring["l0"][key], g_local["l0"][key], f"l0.{key}")
+
+
 class TestLearnsCopy:
     @pytest.mark.slow
     def test_good_config_learns_the_attention_circuit(self):
