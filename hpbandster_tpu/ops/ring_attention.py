@@ -14,9 +14,13 @@ quadratic-in-T buffer anywhere.
 
 Causal runs skip the GEMMs of fully-masked tiles (``lax.cond`` on the
 block order). On a synchronous ring this saves energy, not wall — at
-step t the busiest device still computes one live tile, so lockstep wall
-is unchanged; the known fix is striped/zigzag block ordering that load-
-balances live tiles across devices (left documented, not implemented).
+step t the busiest device still computes one live tile. The wall fix is
+the STRIPED layout (``striped=True``, after Brandon et al.'s Striped
+Attention): device i holds the positions congruent to i mod P, so every
+(Q-stripe, K-stripe) tile is ~half live and the causal work is balanced
+across the ring — no device ever waits on a fully-dead step.
+``make_ring_attention(striped=True)`` permutes global arrays to stripes
+and back internally; the block form expects stripe-layout inputs.
 
 The memory bound holds for TRAINING too: a ``custom_vjp`` saves only this
 device's blocks plus the per-row logsumexp and re-ROTATES K/V around the
@@ -62,7 +66,8 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
 __all__ = [
-    "ring_attention_block", "make_ring_attention", "seq_mesh", "shard_map",
+    "ring_attention_block", "make_ring_attention", "seq_mesh",
+    "stripe_indices", "shard_map",
 ]
 
 #: additive mask value: large-negative (not -inf) so fully-masked tiles
@@ -82,40 +87,71 @@ def _ring_perm(p_size):
     return [(s, (s + 1) % p_size) for s in range(p_size)]
 
 
-def _tile_scores(q_c, k_blk, scale, compute_dtype, causal, q_pos, j, t_k):
+def stripe_indices(t: int, p_size: int):
+    """Index arrays converting a length-``t`` sequence between natural
+    order and the striped layout (device i holds positions ≡ i mod P).
+
+    ``to_striped``: ``x[to_striped]`` is stripe-ordered so a contiguous
+    'seq' sharding gives device i slots ``s`` holding position
+    ``s * P + i``. ``to_natural`` inverts it."""
+    import numpy as np
+
+    assert t % p_size == 0, f"T={t} must divide by the ring size {p_size}"
+    b = t // p_size
+    n = np.arange(t)
+    to_striped = (n % b) * p_size + n // b
+    to_natural = np.empty(t, np.int64)
+    to_natural[to_striped] = n
+    return to_striped, to_natural
+
+
+def _tile_scores(q_c, k_blk, scale, compute_dtype, causal, striped,
+                 i, j, t_q, t_k):
     """[H, Tq, Tk] tile scores: compute_dtype GEMM, f32 accumulation,
-    global-position causal mask."""
+    global-position causal mask (contiguous or striped layout)."""
     s = jnp.einsum(
         "qhd,khd->hqk", q_c, k_blk.astype(compute_dtype),
         preferred_element_type=jnp.float32,
     ) * scale
     if causal:
-        k_pos = j * t_k + jnp.arange(t_k)
-        s = jnp.where((q_pos[:, None] >= k_pos[None, :])[None], s, _MASK)
+        if striped:
+            # striped layout: q position = slot*P + i, k position =
+            # slot*P + j, so the tile's causal set is slot_q > slot_k,
+            # plus the diagonal when i >= j — every tile is ~half live
+            # (the load-balance property)
+            sq = jnp.arange(t_q)[:, None]
+            sk = jnp.arange(t_k)[None, :]
+            live = (sq > sk) | ((sq == sk) & (i >= j))
+        else:
+            q_pos = i * t_q + jnp.arange(t_q)
+            k_pos = j * t_k + jnp.arange(t_k)
+            live = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(live[None], s, _MASK)
     return s
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
-def _ring_attention(axis_name, causal, scale, compute_dtype, q, k, v):
-    out, _ = _ring_attention_fwd(axis_name, causal, scale, compute_dtype,
-                                 q, k, v)
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _ring_attention(axis_name, causal, striped, scale, compute_dtype,
+                    q, k, v):
+    out, _ = _ring_attention_fwd(axis_name, causal, striped, scale,
+                                 compute_dtype, q, k, v)
     return out
 
 
-def _ring_attention_fwd(axis_name, causal, scale, compute_dtype, q, k, v):
+def _ring_attention_fwd(axis_name, causal, striped, scale, compute_dtype,
+                        q, k, v):
     p_size = jax.lax.psum(1, axis_name)
     i = jax.lax.axis_index(axis_name)
     t_q, n_heads, dh = q.shape
     t_k = k.shape[0]
     q_c = q.astype(compute_dtype)
-    q_pos = i * t_q + jnp.arange(t_q)
     perm = _ring_perm(p_size)
 
     def tile_update(j, k_blk, v_blk, m, l, acc):
         """Fold one (Q-block, K/V-block-from-device-j) tile into the
         running online-softmax state."""
         s = _tile_scores(q_c, k_blk, scale, compute_dtype, causal,
-                         q_pos, j, t_k)
+                         striped, i, j, t_q, t_k)
         m_new = jnp.maximum(m, s.max(axis=-1))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[..., None])
@@ -145,11 +181,12 @@ def _ring_attention_fwd(axis_name, causal, scale, compute_dtype, q, k, v):
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         j = (i - t) % p_size  # ring origin after t rotations
-        if causal:
+        if causal and not striped:
             # a tile whose every key position exceeds every query position
             # is fully masked: its probabilities are exactly 0, so skip
             # its GEMMs (under vmap cond lowers to select and computes
-            # both — harmless, just no saving)
+            # both — harmless, just no saving). Striped tiles are ~half
+            # live by construction — nothing to skip.
             m, l, acc = jax.lax.cond(
                 j * t_k > i * t_q + (t_q - 1),
                 lambda: (m, l, acc),
@@ -170,7 +207,8 @@ def _ring_attention_fwd(axis_name, causal, scale, compute_dtype, q, k, v):
     return out, (q, k, v, out, logsumexp)
 
 
-def _ring_attention_bwd(axis_name, causal, scale, compute_dtype, res, dout):
+def _ring_attention_bwd(axis_name, causal, striped, scale, compute_dtype,
+                        res, dout):
     """Flash-attention backward per tile, K/V re-rotated around the ring.
 
     With the saved logsumexp L the softmax probabilities of any tile are
@@ -184,7 +222,6 @@ def _ring_attention_bwd(axis_name, causal, scale, compute_dtype, res, dout):
     t_q, n_heads, dh = q.shape
     t_k = k.shape[0]
     q_c = q.astype(compute_dtype)
-    q_pos = i * t_q + jnp.arange(t_q)
     perm = _ring_perm(p_size)
 
     do_f = dout.astype(jnp.float32)
@@ -194,7 +231,7 @@ def _ring_attention_bwd(axis_name, causal, scale, compute_dtype, res, dout):
 
     def tile_grads(j, k_blk, v_blk, dk_blk, dv_blk, dq):
         s = _tile_scores(q_c, k_blk, scale, compute_dtype, causal,
-                         q_pos, j, t_k)
+                         striped, i, j, t_q, t_k)
         # exact probabilities; masked entries underflow to exactly 0, so
         # no explicit backward mask is needed
         p = jnp.exp(s - logsumexp[..., None])
@@ -229,7 +266,7 @@ def _ring_attention_bwd(axis_name, causal, scale, compute_dtype, res, dout):
         dk_blk = jax.lax.ppermute(dk_blk, axis_name, perm)
         dv_blk = jax.lax.ppermute(dv_blk, axis_name, perm)
         j = (i - t) % p_size
-        if causal:
+        if causal and not striped:
             # fully-masked tile: p == 0 everywhere, all its gradient
             # contributions are exactly 0 — skip the four GEMMs
             dk_blk, dv_blk, dq = jax.lax.cond(
@@ -265,19 +302,23 @@ def ring_attention_block(
     causal: bool = True,
     scale: Optional[float] = None,
     compute_dtype=jnp.bfloat16,
+    striped: bool = False,
 ) -> jax.Array:
     """Exact attention for this device's query block; call inside shard_map.
 
-    ``q``/``k``/``v``: this shard's blocks, ``[T_blk, H, dh]`` (the global
-    sequence is the concatenation over the ``axis_name`` ring, in axis
-    order). Causal masking uses GLOBAL positions, so the result equals
-    dense causal attention over the full sequence — and so do its
-    gradients (the custom VJP re-rotates K/V instead of saving residuals,
-    keeping training memory at O(T/P · d) per device).
+    ``q``/``k``/``v``: this shard's blocks, ``[T_blk, H, dh]``. With
+    ``striped=False`` the global sequence is the concatenation over the
+    ``axis_name`` ring in axis order; with ``striped=True`` the caller
+    has laid positions out in stripes (device i holds positions ≡ i mod
+    P — see :func:`stripe_indices`), which balances causal-mask work
+    across the ring. Causal masking uses GLOBAL positions either way, so
+    the result equals dense causal attention over the full sequence —
+    and so do its gradients (the custom VJP re-rotates K/V instead of
+    saving residuals, keeping training memory at O(T/P · d) per device).
     """
     scale = float(q.shape[-1] ** -0.5 if scale is None else scale)
-    return _ring_attention(axis_name, bool(causal), scale, compute_dtype,
-                           q, k, v)
+    return _ring_attention(axis_name, bool(causal), bool(striped), scale,
+                           compute_dtype, q, k, v)
 
 
 def make_ring_attention(
@@ -287,24 +328,44 @@ def make_ring_attention(
     causal: bool = True,
     scale: Optional[float] = None,
     compute_dtype=jnp.bfloat16,
+    striped: bool = False,
 ):
-    """``fn(q, k, v)`` over GLOBAL ``[T, H, dh]`` arrays, sequence axis
-    sharded over ``mesh[axis]``; jittable, differentiable, vmappable.
+    """``fn(q, k, v)`` over GLOBAL ``[T, H, dh]`` arrays in natural
+    order, sequence axis sharded over ``mesh[axis]``; jittable,
+    differentiable, vmappable.
+
+    ``striped=True`` permutes the inputs to the striped layout before
+    sharding and the output back to natural order (two O(T) gathers),
+    so every device's causal tiles are ~half live — the load-balanced
+    schedule for causal long-context work.
 
     T must divide evenly by the axis size (shard_map's partitioning
     contract — pad the sequence to a multiple, the standard TPU practice
     for static shapes)."""
     spec = PartitionSpec(axis, None, None)
+    p_size = int(mesh.shape[axis])
+    # non-causal attention has no mask imbalance to balance: the stripe
+    # permutations would be pure overhead for a bit-identical result
+    striped = bool(striped) and bool(causal)
 
     def fn(q, k, v):
-        return shard_map(
+        if striped:
+            # q and k/v may have different lengths (cross-attention-style
+            # calls the contiguous path supports); stripe each with its
+            # own index set — the striped mask algebra only needs both to
+            # share the ring's modulus
+            q_str, q_nat = stripe_indices(q.shape[0], p_size)
+            kv_str, _ = stripe_indices(k.shape[0], p_size)
+            q, k, v = q[q_str], k[kv_str], v[kv_str]
+        out = shard_map(
             lambda qb, kb, vb: ring_attention_block(
                 qb, kb, vb, axis, causal=causal, scale=scale,
-                compute_dtype=compute_dtype,
+                compute_dtype=compute_dtype, striped=striped,
             ),
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
         )(q, k, v)
+        return out[q_nat] if striped else out
 
     return fn
