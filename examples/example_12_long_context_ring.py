@@ -1,0 +1,88 @@
+"""Example 12: long-context attention — the sequence axis over the mesh.
+
+A sequence far longer than one device would want to hold is attended
+EXACTLY by sharding positions across the mesh's 'seq' ring
+(`ops/ring_attention.py`): queries stay resident while K/V blocks rotate
+via `lax.ppermute` with online-softmax accumulation, and the custom VJP
+re-rotates K/V in the backward pass so TRAINING memory stays O(T/P · d)
+per device too. `--striped` selects the load-balanced causal schedule
+(positions striped across the ring) — same exact result, balanced work.
+
+The demo runs forward + backward at a context length scaled by the ring
+size, checks the result against dense attention on a small prefix, and
+prints the per-device memory arithmetic that makes the point.
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hpbandster_tpu.ops.ring_attention import make_ring_attention, seq_mesh
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq_per_device", type=int, default=512)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--head_dim", type=int, default=64)
+    p.add_argument("--striped", action="store_true")
+    args = p.parse_args()
+
+    mesh = seq_mesh()
+    n = len(jax.devices())
+    t = args.seq_per_device * n
+    h, dh = args.heads, args.head_dim
+
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(kq, (t, h, dh), jnp.float32)
+    k = jax.random.normal(kk, (t, h, dh), jnp.float32)
+    v = jax.random.normal(kv, (t, h, dh), jnp.float32)
+
+    ring = make_ring_attention(mesh, striped=args.striped)
+
+    def loss(q, k, v):
+        return (ring(q, k, v) ** 2).mean()
+
+    grad_fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    t0 = time.perf_counter()
+    grads = grad_fn(q, k, v)
+    jax.block_until_ready(grads)
+    compile_and_run = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    jax.block_until_ready(grad_fn(q, k, v))
+    steady = time.perf_counter() - t0
+
+    # correctness spot-check: CAUSAL output at position p depends only on
+    # positions <= p, so the ring's first rows must equal dense attention
+    # computed over just that prefix
+    t0_chk = min(128, t)
+    out = jax.jit(ring)(q, k, v)
+    s = jnp.einsum("qhd,khd->hqk", q[:t0_chk], k[:t0_chk]) * (dh ** -0.5)
+    s = jnp.where(jnp.tril(jnp.ones((t0_chk, t0_chk), bool))[None], s, -1e30)
+    dense = jnp.einsum(
+        "hqk,khd->qhd", jax.nn.softmax(s, -1), v[:t0_chk]
+    )
+    err = float(jnp.abs(out[:t0_chk] - dense).max())
+    assert err < 5e-2, f"ring diverged from dense on the prefix: {err}"
+
+    blk_mb = args.seq_per_device * h * dh * 4 / 2**20
+    full_mb = t * h * dh * 4 / 2**20
+    print(f"devices: {n} ({jax.devices()[0].platform}); "
+          f"context T={t} ({args.seq_per_device}/device), "
+          f"H={h}, dh={dh}, striped={args.striped}")
+    print(f"prefix parity vs dense (first {t0_chk} positions): "
+          f"max|d| = {err:.1e}")
+    print(f"per-device K or V block: {blk_mb:.1f} MiB; "
+          f"full-sequence K or V: {full_mb:.1f} MiB — the ring never "
+          f"materializes the full tensor, forward OR backward")
+    print(f"forward+backward: {compile_and_run:.2f}s incl. compile, "
+          f"{steady:.2f}s steady-state")
+    assert all(np.isfinite(np.asarray(g)).all() for g in grads)
+    print("grads finite: OK")
+
+
+if __name__ == "__main__":
+    main()

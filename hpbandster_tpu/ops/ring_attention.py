@@ -334,10 +334,13 @@ def make_ring_attention(
     order, sequence axis sharded over ``mesh[axis]``; jittable,
     differentiable, vmappable.
 
-    ``striped=True`` permutes the inputs to the striped layout before
-    sharding and the output back to natural order (two O(T) gathers),
-    so every device's causal tiles are ~half live — the load-balanced
-    schedule for causal long-context work.
+    ``striped=True`` relayouts the inputs to stripes before sharding and
+    the output back to natural order — as reshape/transpose (free of
+    materialized index constants; XLA lowers them as cheap copies, often
+    fused into the sharding), so every device's causal tiles are ~half
+    live: the load-balanced schedule for causal long-context work.
+    Non-causal calls skip the relayout (nothing to balance; the result
+    is identical either way).
 
     T must divide evenly by the axis size (shard_map's partitioning
     contract — pad the sequence to a multiple, the standard TPU practice
@@ -348,15 +351,26 @@ def make_ring_attention(
     # permutations would be pure overhead for a bit-identical result
     striped = bool(striped) and bool(causal)
 
+    def to_stripes(x):
+        # natural -> striped is exactly a (b, P) -> (P, b) transpose of
+        # the leading axis: new index i*b + s holds position s*P + i.
+        # Same relayout as stripe_indices, without baking length-T index
+        # constants into the jaxpr (XLA lowers this as a copy, not a
+        # gather) — q and k/v may have different lengths; each uses its
+        # own block size (the striped mask only needs a shared modulus P)
+        t = x.shape[0]
+        assert t % p_size == 0, f"T={t} must divide by the ring size"
+        return (x.reshape(t // p_size, p_size, *x.shape[1:])
+                .swapaxes(0, 1).reshape(x.shape))
+
+    def to_natural(x):
+        t = x.shape[0]
+        return (x.reshape(p_size, t // p_size, *x.shape[1:])
+                .swapaxes(0, 1).reshape(x.shape))
+
     def fn(q, k, v):
         if striped:
-            # q and k/v may have different lengths (cross-attention-style
-            # calls the contiguous path supports); stripe each with its
-            # own index set — the striped mask algebra only needs both to
-            # share the ring's modulus
-            q_str, q_nat = stripe_indices(q.shape[0], p_size)
-            kv_str, _ = stripe_indices(k.shape[0], p_size)
-            q, k, v = q[q_str], k[kv_str], v[kv_str]
+            q, k, v = to_stripes(q), to_stripes(k), to_stripes(v)
         out = shard_map(
             lambda qb, kb, vb: ring_attention_block(
                 qb, kb, vb, axis, causal=causal, scale=scale,
@@ -366,6 +380,6 @@ def make_ring_attention(
             in_specs=(spec, spec, spec),
             out_specs=spec,
         )(q, k, v)
-        return out[q_nat] if striped else out
+        return to_natural(out) if striped else out
 
     return fn

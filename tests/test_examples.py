@@ -129,6 +129,15 @@ def test_example_10_multihost_fused_spmd():
     assert "SPMD OK" in out
 
 
+def test_example_12_long_context_ring():
+    out = run_example(
+        "example_12_long_context_ring.py", "--seq_per_device", "32",
+        "--head_dim", "16", "--striped",
+    )
+    assert "never" in out and "grads finite: OK" in out
+    assert "prefix parity vs dense" in out
+
+
 @pytest.mark.slow
 def test_example_11_transformer_fused():
     out = run_example(

@@ -90,21 +90,36 @@ class TestRingAttentionParity:
             np.asarray(g), np.asarray(g_ref), atol=2e-5, rtol=2e-4
         )
 
-    @pytest.mark.parametrize("causal", [True, False])
-    def test_striped_layout_matches_dense(self, causal):
+    def test_striped_layout_matches_dense(self):
         # the load-balanced causal schedule: positions striped across the
-        # ring (device i holds p ≡ i mod P), permuted in/out by the
-        # wrapper — results must still be exactly dense attention
+        # ring (device i holds p ≡ i mod P), relayouted in/out by the
+        # wrapper — results must still be exactly dense attention. Only
+        # causal is meaningful here: make_ring_attention downgrades
+        # non-causal striped to the contiguous path (nothing to balance),
+        # which test_striped_noncausal_downgrades pins.
         q, k, v = _qkv(jax.random.key(5))
         ring = make_ring_attention(
-            seq_mesh(), causal=causal, compute_dtype=jnp.float32,
+            seq_mesh(), causal=True, compute_dtype=jnp.float32,
             striped=True,
         )
         out = jax.jit(ring)(q, k, v)
-        ref = dense_attention(q, k, v, causal)
+        ref = dense_attention(q, k, v, True)
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
         )
+
+    def test_striped_noncausal_downgrades_to_contiguous(self):
+        # non-causal attention has no mask imbalance: striped=True must
+        # produce bit-identical results to the contiguous path (the
+        # wrapper skips the relayout entirely)
+        q, k, v = _qkv(jax.random.key(7))
+        a = jax.jit(make_ring_attention(
+            seq_mesh(), causal=False, compute_dtype=jnp.float32,
+            striped=True))(q, k, v)
+        b = jax.jit(make_ring_attention(
+            seq_mesh(), causal=False, compute_dtype=jnp.float32,
+            striped=False))(q, k, v)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
     def test_striped_grads_match_dense(self):
         q, k, v = _qkv(jax.random.key(6))
