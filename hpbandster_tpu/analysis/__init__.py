@@ -1,0 +1,36 @@
+"""graftlint — the repo-native static-analysis subsystem.
+
+Usage::
+
+    python -m hpbandster_tpu.analysis [paths...]      # exit 1 on findings
+
+    from hpbandster_tpu.analysis import run, format_report
+    findings = run(["hpbandster_tpu", "tests"])
+
+See ``docs/static_analysis.md`` for the rule catalogue, the suppression
+syntax, and how to add a rule.
+"""
+
+from hpbandster_tpu.analysis.core import (
+    DEFAULT_EXCLUDE_DIRS,
+    Finding,
+    Rule,
+    SourceModule,
+    all_rules,
+    collect_files,
+    format_report,
+    register,
+    run,
+)
+
+__all__ = [
+    "DEFAULT_EXCLUDE_DIRS",
+    "Finding",
+    "Rule",
+    "SourceModule",
+    "all_rules",
+    "collect_files",
+    "format_report",
+    "register",
+    "run",
+]

@@ -1,0 +1,68 @@
+"""CLI: ``python -m hpbandster_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage error. Default paths are the two
+trees the repo gates itself on (``hpbandster_tpu`` and ``tests``), resolved
+relative to the current directory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from hpbandster_tpu.analysis.core import all_rules, format_report, run
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m hpbandster_tpu.analysis",
+        description="graftlint: JAX- and concurrency-aware static analysis",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["hpbandster_tpu", "tests"],
+        help="files/directories to scan (default: hpbandster_tpu tests)",
+    )
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated subset of rules to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalogue and exit"
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as a JSON array instead of text",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name, cls in sorted(all_rules().items()):
+            print(f"{name:24s} {cls.description}")
+        return 0
+
+    rules = None
+    if args.rules is not None:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    try:
+        findings = run(args.paths, rules=rules)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps(
+            [
+                {"rule": f.rule, "path": f.path, "line": f.line, "message": f.message}
+                for f in findings
+            ],
+            indent=2,
+        ))
+    else:
+        print(format_report(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,253 @@
+"""graftlint core — rule registry, suppression handling, runner, report.
+
+The generic linters this repo could reach for (flake8, pylint) are blind to
+its three bug-prone idioms: traced JAX code (``ops/``, ``models/``),
+hand-rolled threading (``parallel/``), and stateful PRNG-key plumbing.
+``graftlint`` is an AST-level pass tuned to exactly those failure modes —
+tracer leaks inside ``jit``, PRNG key reuse, lock-protected state touched
+without the lock — the bug classes that corrupt a BOHB sweep *silently*
+(a KDE fed correlated samples still fits; it just fits garbage).
+
+Design:
+
+* a :class:`Rule` inspects one parsed :class:`SourceModule` at a time and
+  returns :class:`Finding` objects with exact ``file:line`` locations;
+* rules self-register via the :func:`register` decorator — adding a rule is
+  dropping a module into ``analysis/rules/`` (see ``docs/static_analysis.md``);
+* per-rule suppression comments::
+
+      risky_line()  # graftlint: disable=<rule>[,<rule2>] — justification
+
+  A directive on a code line suppresses that line; a directive on a
+  comment-only line suppresses the next line. ``disable=all`` mutes every
+  rule. Suppressions are expected to carry a justification after the rule
+  list — the analyzer does not parse it, reviewers do;
+* :func:`run` walks files/directories (skipping ``analysis_fixtures``,
+  caches, VCS dirs) and returns sorted findings; the CLI in ``__main__``
+  exits non-zero when any survive, so the repo gates itself in
+  ``tests/test_analysis_selfcheck.py``.
+
+Everything here is stdlib-only (``ast`` + ``tokenize``): the pass must stay
+in the fast test lane, so importing it must not drag in jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Type
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "SourceModule",
+    "register",
+    "all_rules",
+    "collect_files",
+    "run",
+    "format_report",
+    "DEFAULT_EXCLUDE_DIRS",
+]
+
+#: directory basenames the walker never descends into. ``analysis_fixtures``
+#: holds deliberately-bad rule fixtures; they are only scanned when named
+#: explicitly (the rule tests do exactly that).
+DEFAULT_EXCLUDE_DIRS = frozenset(
+    {"__pycache__", ".git", ".pytest_cache", "analysis_fixtures", ".ipynb_checkpoints"}
+)
+
+_DIRECTIVE_RE = re.compile(r"graftlint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic: ``path:line: [rule] message``."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def __str__(self) -> str:
+        return f"{self.location}: [{self.rule}] {self.message}"
+
+
+class SourceModule:
+    """A parsed source file plus its suppression table."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        #: line -> set of rule names muted on that line ("all" mutes any)
+        self.suppressions: Dict[int, Set[str]] = _parse_suppressions(text)
+        #: scratch memo shared by rules (e.g. the resolved import map) so
+        #: per-module derived structures are built once, not once per rule
+        self.cache: Dict[str, object] = {}
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        muted = self.suppressions.get(line, ())
+        return "all" in muted or rule in muted
+
+
+def _parse_suppressions(text: str) -> Dict[int, Set[str]]:
+    """Scan comments with ``tokenize`` (immune to '#' inside strings).
+
+    A directive inside a statement applies to every physical line of that
+    *logical* line — findings anchor to a statement's first line, so a
+    trailing comment on the closing paren of a wrapped call still
+    suppresses it. A directive on a comment-only line applies to the
+    following line (room for a longer justification above the code).
+    """
+    table: Dict[int, Set[str]] = {}
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(text).readline))
+    except (tokenize.TokenError, IndentationError):  # half-written file
+        return table
+    _NONCODE = (
+        tokenize.COMMENT,
+        tokenize.NL,
+        tokenize.NEWLINE,
+        tokenize.INDENT,
+        tokenize.DEDENT,
+        tokenize.ENCODING,
+    )
+    logical_start: Optional[int] = None
+    for tok in tokens:
+        if tok.type == tokenize.NEWLINE:
+            logical_start = None
+            continue
+        if tok.type not in _NONCODE and logical_start is None:
+            logical_start = tok.start[0]
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _DIRECTIVE_RE.search(tok.string)
+        if m is None:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        line = tok.start[0]
+        if logical_start is None:
+            targets = [line + 1]  # comment-only line: excuse what follows
+        else:
+            targets = range(logical_start, line + 1)
+        for target in targets:
+            table.setdefault(target, set()).update(rules)
+    return table
+
+
+# --------------------------------------------------------------------- rules
+class Rule:
+    """Base class: subclass, set ``name``/``description``, implement
+    :meth:`check`, decorate with :func:`register`."""
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, module: SourceModule) -> List[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: SourceModule, node: "ast.AST | int", message: str) -> Finding:
+        line = node if isinstance(node, int) else getattr(node, "lineno", 1)
+        return Finding(rule=self.name, path=module.path, line=line, message=message)
+
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    if not cls.name:
+        raise ValueError(f"rule class {cls.__name__} has no name")
+    if cls.name in _REGISTRY:
+        raise ValueError(f"duplicate rule name {cls.name!r}")
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, Type[Rule]]:
+    """Name -> rule class, importing the bundled rule pack on first use."""
+    from hpbandster_tpu.analysis import rules  # noqa: F401  (side-effect: register)
+
+    return dict(_REGISTRY)
+
+
+# -------------------------------------------------------------------- runner
+def collect_files(
+    paths: Sequence[str], exclude_dirs: Iterable[str] = DEFAULT_EXCLUDE_DIRS
+) -> Iterator[str]:
+    """Yield .py files under ``paths`` deterministically. Explicit file paths
+    bypass the exclusion list — that is how the fixture tests scan known-bad
+    modules the default walk skips."""
+    exclude = set(exclude_dirs)
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(d for d in dirnames if d not in exclude)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def run(
+    paths: Sequence[str],
+    rules: Optional[Sequence[str]] = None,
+    exclude_dirs: Iterable[str] = DEFAULT_EXCLUDE_DIRS,
+) -> List[Finding]:
+    """Run ``rules`` (default: all registered) over ``paths``; returns
+    suppression-filtered findings sorted by location.
+
+    Unreadable/unparseable files surface as ``parse-error`` findings rather
+    than crashing the pass: a syntax error must fail the gate, not hide."""
+    registry = all_rules()
+    if rules is None:
+        selected = [cls() for cls in registry.values()]
+    else:
+        unknown = [r for r in rules if r not in registry]
+        if unknown:
+            raise KeyError(f"unknown rule(s): {', '.join(unknown)}")
+        selected = [registry[r]() for r in rules]
+
+    findings: List[Finding] = []
+    # a typo'd path must trip the gate, not scan zero files and pass
+    for path in paths:
+        if not os.path.exists(path):
+            findings.append(
+                Finding("parse-error", path, 1, "path does not exist — nothing was scanned")
+            )
+    for path in collect_files(paths, exclude_dirs):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                text = fh.read()
+            module = SourceModule(path, text)
+        except (OSError, SyntaxError, ValueError) as e:
+            findings.append(
+                Finding("parse-error", path, getattr(e, "lineno", None) or 1, repr(e))
+            )
+            continue
+        for rule in selected:
+            for f in rule.check(module):
+                if not module.is_suppressed(f.rule, f.line):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings
+
+
+def format_report(findings: Sequence[Finding]) -> str:
+    if not findings:
+        return "graftlint: clean"
+    lines = [str(f) for f in findings]
+    by_rule: Dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    summary = ", ".join(f"{n}× {r}" for r, n in sorted(by_rule.items()))
+    lines.append(f"graftlint: {len(findings)} finding(s) ({summary})")
+    return "\n".join(lines)

@@ -1,0 +1,14 @@
+"""Bundled graftlint rule pack — importing this package registers every rule.
+
+Add a rule by dropping a module here that defines a ``Rule`` subclass
+decorated with ``@register`` and importing it below (see
+``docs/static_analysis.md`` for the walkthrough).
+"""
+
+from hpbandster_tpu.analysis.rules import (  # noqa: F401
+    exceptions,
+    jit_purity,
+    locks,
+    markers,
+    prng,
+)

@@ -1,0 +1,81 @@
+"""Shared AST helpers for the rule pack: dotted-name resolution through
+module import aliases, and generic node walks."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+__all__ = [
+    "dotted_name",
+    "ImportMap",
+    "import_map_for",
+    "iter_functions",
+    "names_in",
+]
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ImportMap:
+    """Resolve local names to canonical module paths.
+
+    ``import jax.random as jr`` -> ``jr`` maps to ``jax.random``;
+    ``from jax import random`` -> ``random`` maps to ``jax.random``;
+    ``from jax.random import split as sp`` -> ``sp`` maps to
+    ``jax.random.split``. :meth:`resolve` canonicalizes a dotted expression
+    through this table so rules can match on true module paths.
+    """
+
+    def __init__(self, tree: ast.Module):
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def resolve(self, expr: ast.AST) -> Optional[str]:
+        name = dotted_name(expr)
+        if name is None:
+            return None
+        head, _, rest = name.partition(".")
+        base = self.aliases.get(head, head)
+        return f"{base}.{rest}" if rest else base
+
+
+def import_map_for(module) -> "ImportMap":
+    """Per-module ImportMap, built once and memoized on the SourceModule."""
+    imports = module.cache.get("import_map")
+    if imports is None:
+        imports = ImportMap(module.tree)
+        module.cache["import_map"] = imports
+    return imports
+
+
+def iter_functions(tree: ast.AST) -> Iterator[ast.AST]:
+    """All FunctionDef/AsyncFunctionDef nodes, any nesting depth."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def names_in(node: ast.AST) -> Set[str]:
+    """Every bare Name id referenced anywhere inside ``node``."""
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}

@@ -1,0 +1,240 @@
+"""jit-host-sync — host synchronization inside traced JAX code.
+
+``float(x)``, ``int(x)``, ``bool(x)``, ``x.item()``, ``x.tolist()``,
+``np.asarray(x)`` / ``np.array(x)`` and ``jax.device_get(x)`` applied to a
+traced value inside a ``jit``/``vmap``/``pmap``-ed function either raise a
+``TracerConversionError`` at trace time or — worse, under ``io_callback``
+style escapes — silently force a device round-trip per call. Python
+``if``/``while`` on a traced value is the same bug wearing control-flow
+clothes.
+
+What counts as *traced* is inferred conservatively, so the rule stays
+quiet on the static-shape arithmetic idiomatic in this repo (``float(
+budgets[s])`` on a closed-over Python tuple is fine and not flagged):
+
+* a function is traced when it is decorated with ``jax.jit``/``pmap``/
+  ``vmap`` (directly or via ``functools.partial``), or its name appears
+  inside the arguments of such a wrapper call anywhere in the module
+  (``jax.jit(batch_fn)``, ``jax.jit(shard_map(ring, ...))``);
+* inside it, traced values are the non-static parameters
+  (``static_argnames``/``static_argnums`` are parsed and excluded) plus
+  anything assigned from an expression that references a traced name.
+
+Cross-module wrapping (``jax.jit(imported_fn)``) is out of scope — the
+rule runs per module; the wrapped module gets its own scan when its own
+jit sites are declared there.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from hpbandster_tpu.analysis.core import Finding, Rule, SourceModule, register
+from hpbandster_tpu.analysis.rules._util import ImportMap, import_map_for
+
+_JIT_WRAPPERS = {
+    "jax.jit",
+    "jax.pmap",
+    "jax.vmap",
+    "jax.numpy.vectorize",
+    "jit",
+    "pmap",
+    "vmap",
+}
+
+_CASTS = {"float", "int", "bool", "complex"}
+_NUMPY_SINKS = {"asarray", "array", "copy", "ascontiguousarray"}
+_METHOD_SINKS = {"item", "tolist", "__array__"}
+
+
+def _is_jit_expr(node: ast.AST, imports: ImportMap) -> bool:
+    """True for ``jax.jit`` / ``partial(jax.jit, ...)`` / ``jax.jit(...)``
+    expressions (decorator or callee position)."""
+    resolved = imports.resolve(node)
+    if resolved in _JIT_WRAPPERS:
+        return True
+    if isinstance(node, ast.Call):
+        fn = imports.resolve(node.func)
+        if fn in _JIT_WRAPPERS:
+            return True
+        if fn in ("functools.partial", "partial"):
+            return any(_is_jit_expr(a, imports) for a in node.args)
+    return False
+
+
+def _static_params(dec: ast.AST, fn: ast.FunctionDef) -> Set[str]:
+    """Parameter names excluded from tracing by static_argnames/argnums."""
+    static: Set[str] = set()
+    calls = [dec] if isinstance(dec, ast.Call) else []
+    for call in calls:
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                try:
+                    val = ast.literal_eval(kw.value)
+                except ValueError:
+                    continue
+                names = [val] if isinstance(val, str) else list(val)
+                static.update(str(n) for n in names)
+            elif kw.arg == "static_argnums":
+                try:
+                    val = ast.literal_eval(kw.value)
+                except ValueError:
+                    continue
+                nums = [val] if isinstance(val, int) else list(val)
+                params = [a.arg for a in list(fn.args.posonlyargs) + list(fn.args.args)]
+                for i in nums:
+                    if isinstance(i, int) and 0 <= i < len(params):
+                        static.add(params[i])
+    return static
+
+
+@register
+class JitHostSyncRule(Rule):
+    name = "jit-host-sync"
+    description = (
+        "host-sync call (float/int/bool/.item/np.asarray/device_get or Python "
+        "branch) on a traced value inside a jit/vmap/pmap-ed function"
+    )
+
+    def check(self, module: SourceModule) -> List[Finding]:
+        # sound prefilter: a traced function requires one of these tokens
+        if not any(t in module.text for t in ("jit", "pmap", "vmap", "vectorize")):
+            return []
+        imports = import_map_for(module)
+        traced_fns = self._traced_functions(module.tree, imports)
+        findings: List[Finding] = []
+        for fn, static in traced_fns.items():
+            findings.extend(self._check_traced_fn(module, imports, fn, static))
+        return findings
+
+    # ------------------------------------------------------------- discovery
+    def _traced_functions(
+        self, tree: ast.Module, imports: ImportMap
+    ) -> Dict[ast.FunctionDef, Set[str]]:
+        by_name: Dict[str, List[ast.FunctionDef]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef):
+                by_name.setdefault(node.name, []).append(node)
+
+        traced: Dict[ast.FunctionDef, Set[str]] = {}
+
+        def mark(fn: ast.FunctionDef, static: Set[str]) -> None:
+            traced.setdefault(fn, set()).update(static)
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef):
+                for dec in node.decorator_list:
+                    if _is_jit_expr(dec, imports):
+                        mark(node, _static_params(dec, node))
+            if isinstance(node, ast.Call) and _is_jit_expr(node.func, imports):
+                for arg in node.args:
+                    for inner in ast.walk(arg):
+                        if isinstance(inner, ast.Name) and inner.id in by_name:
+                            for fn in by_name[inner.id]:
+                                mark(fn, _static_params(node, fn))
+        return traced
+
+    # -------------------------------------------------------------- analysis
+    def _check_traced_fn(
+        self,
+        module: SourceModule,
+        imports: ImportMap,
+        fn: ast.FunctionDef,
+        static: Set[str],
+    ) -> List[Finding]:
+        traced: Set[str] = {
+            a.arg
+            for a in (
+                list(fn.args.posonlyargs) + list(fn.args.args) + list(fn.args.kwonlyargs)
+            )
+            if a.arg not in static and a.arg not in ("self", "cls")
+        }
+        if fn.args.vararg is not None:
+            traced.add(fn.args.vararg.arg)
+
+        def refs_traced(node: ast.AST) -> bool:
+            return any(
+                isinstance(n, ast.Name) and n.id in traced for n in ast.walk(node)
+            )
+
+        def taint_target(tgt: ast.expr) -> None:
+            # a subscript store taints the container, never the index names
+            # (`counts[b] = traced` says nothing about `b`)
+            while isinstance(tgt, (ast.Subscript, ast.Starred)):
+                tgt = tgt.value
+            if isinstance(tgt, ast.Name):
+                traced.add(tgt.id)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                for el in tgt.elts:
+                    taint_target(el)
+
+        # two forward passes: assignments referencing traced names taint
+        # their targets (handles use-before-def between helpers once)
+        for _ in range(2):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and refs_traced(node.value):
+                    for tgt in node.targets:
+                        taint_target(tgt)
+                elif isinstance(node, ast.AugAssign) and refs_traced(node.value):
+                    taint_target(node.target)
+
+        findings: List[Finding] = []
+
+        def flag(node: ast.AST, what: str) -> None:
+            findings.append(
+                self.finding(
+                    module,
+                    node,
+                    f"{what} on a traced value inside traced function "
+                    f"{fn.name!r} forces a host sync (or raises at trace time)",
+                )
+            )
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                callee = imports.resolve(node.func)
+                if (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in _CASTS
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in traced
+                ):
+                    flag(node, f"{node.func.id}()")
+                elif (
+                    callee is not None
+                    and node.args
+                    and refs_traced(node.args[0])
+                    and (
+                        callee == "jax.device_get"
+                        or (
+                            callee.startswith(("numpy.", "np."))
+                            and callee.rsplit(".", 1)[-1] in _NUMPY_SINKS
+                        )
+                    )
+                ):
+                    flag(node, callee)
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METHOD_SINKS
+                    and refs_traced(node.func.value)
+                ):
+                    flag(node, f".{node.func.attr}()")
+            elif isinstance(node, (ast.If, ast.While)):
+                # only bare traced names as direct operands: `if x:` /
+                # `if x > 0:` are tracer bool-coercions; `if f(x) ...` is
+                # left alone (f may be static — shape math, trained_split)
+                test = node.test
+                operands: List[ast.expr] = [test]
+                if isinstance(test, ast.Compare):
+                    operands = [test.left, *test.comparators]
+                elif isinstance(test, ast.BoolOp):
+                    operands = list(test.values)
+                elif isinstance(test, ast.UnaryOp):
+                    operands = [test.operand]
+                if any(
+                    isinstance(op, ast.Name) and op.id in traced for op in operands
+                ):
+                    flag(node, "Python branch")
+        return findings

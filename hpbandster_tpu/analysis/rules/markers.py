@@ -1,0 +1,138 @@
+"""pytest-marker — compile-heavy tests missing the ``slow`` marker.
+
+The fast lane (``pytest -m 'not slow'``, the tier-1 gate) must stay under
+control: one unmarked ``pmap`` test or hundred-bracket sweep quietly adds
+minutes for every future PR. This rule encodes the repo's marking policy
+(``pytest.ini``) as thresholds calibrated to the current suite — every
+fast-lane test today sits well under them:
+
+* calls ``jax.pmap`` (multi-device compile: always slow on CPU meshes);
+* passes ``n_iterations=N`` with ``N >= 16`` (a bracket per iteration —
+  each a compile + full SH ladder);
+* passes ``max_budget=B`` with ``B >= 243`` (the eta=3 ladder grows a rung:
+  compile-heavier fused sweeps, longer training loops);
+* a ``for _ in range(N>=64)`` loop whose body jits.
+
+Only files named ``test_*.py`` are inspected. A ``slow`` marker on the
+function, its class, or the module-level ``pytestmark`` clears it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional
+
+from hpbandster_tpu.analysis.core import Finding, Rule, SourceModule, register
+from hpbandster_tpu.analysis.rules._util import dotted_name
+
+_N_ITERATIONS_MAX = 16
+_MAX_BUDGET_MAX = 243
+_RANGE_LOOP_MAX = 64
+
+
+def _has_slow_marker(decorators: List[ast.expr]) -> bool:
+    for dec in decorators:
+        node = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(node) or ""
+        if name.endswith("mark.slow") or name == "slow":
+            return True
+    return False
+
+
+def _pytestmark_is_slow(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "pytestmark" for t in stmt.targets
+        ):
+            for node in ast.walk(stmt.value):
+                if (dotted_name(node) or "").endswith("mark.slow"):
+                    return True
+    return False
+
+
+def _const_number(node: ast.expr) -> Optional[float]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)):
+        return float(node.value)
+    return None
+
+
+@register
+class PytestMarkerRule(Rule):
+    name = "pytest-marker"
+    description = (
+        "test compiles/pmaps or exceeds iteration/budget thresholds but lacks "
+        "@pytest.mark.slow"
+    )
+
+    def check(self, module: SourceModule) -> List[Finding]:
+        if not os.path.basename(module.path).startswith("test_"):
+            return []
+        if _pytestmark_is_slow(module.tree.body):
+            return []
+        findings: List[Finding] = []
+        for node in module.tree.body:
+            if isinstance(node, ast.ClassDef):
+                if _has_slow_marker(node.decorator_list) or _pytestmark_is_slow(node.body):
+                    continue
+                for sub in node.body:
+                    self._check_test(module, sub, findings)
+            else:
+                self._check_test(module, node, findings)
+        return findings
+
+    def _check_test(
+        self, module: SourceModule, node: ast.stmt, findings: List[Finding]
+    ) -> None:
+        if not isinstance(node, ast.FunctionDef) or not node.name.startswith("test"):
+            return
+        if _has_slow_marker(node.decorator_list):
+            return
+        reason = self._slow_reason(node)
+        if reason is not None:
+            findings.append(
+                self.finding(
+                    module, node,
+                    f"test {node.name!r} {reason} but has no @pytest.mark.slow — "
+                    "mark it (or shrink it under the fast-lane thresholds)",
+                )
+            )
+
+    def _slow_reason(self, fn: ast.FunctionDef) -> Optional[str]:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                callee = dotted_name(node.func) or ""
+                if callee in ("jax.pmap", "pmap"):
+                    return "calls jax.pmap (multi-device compile)"
+                for kw in node.keywords:
+                    val = _const_number(kw.value) if kw.arg else None
+                    if kw.arg == "n_iterations" and val is not None and val >= _N_ITERATIONS_MAX:
+                        return f"runs n_iterations={int(val)} (>= {_N_ITERATIONS_MAX} brackets)"
+                    if kw.arg == "max_budget" and val is not None and val >= _MAX_BUDGET_MAX:
+                        return f"uses max_budget={val:g} (>= {_MAX_BUDGET_MAX})"
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                n = self._range_bound(node.iter)
+                if n is not None and n >= _RANGE_LOOP_MAX and self._body_jits(node):
+                    return f"jit-compiles inside a range({int(n)}) loop"
+        return None
+
+    @staticmethod
+    def _range_bound(iter_expr: ast.expr) -> Optional[float]:
+        if (
+            isinstance(iter_expr, ast.Call)
+            and isinstance(iter_expr.func, ast.Name)
+            and iter_expr.func.id == "range"
+            and iter_expr.args
+        ):
+            stop = iter_expr.args[1] if len(iter_expr.args) >= 2 else iter_expr.args[0]
+            return _const_number(stop)
+        return None
+
+    @staticmethod
+    def _body_jits(loop: ast.stmt) -> bool:
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Call):
+                callee = dotted_name(node.func) or ""
+                if callee in ("jax.jit", "jit", "jax.pmap", "pmap"):
+                    return True
+        return False

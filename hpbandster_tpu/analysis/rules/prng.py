@@ -1,0 +1,333 @@
+"""prng-reuse — JAX PRNG key hygiene.
+
+Three failure modes, all of which corrupt a BOHB sweep *silently* (the KDE
+still fits — on correlated samples):
+
+1. **reuse** — the same key value consumed by two ``jax.random`` calls
+   (samplers *or* ``split``): both draws are perfectly correlated;
+2. **stale key in a loop** — a key created outside a loop consumed inside
+   it without a per-iteration ``split``/reassignment: every iteration
+   redraws the same numbers;
+3. **discarded split** — a ``split()`` whose result (or part of it, via
+   ``_`` targets) is thrown away: somebody paid for fresh entropy and then
+   dropped it, which usually means the *old* key is about to be reused.
+
+The tracker is flow-sensitive but deliberately simple: statements are
+walked in order per function, each assignment creates a fresh *version* of
+the target name, and a version consumed twice on branch-compatible paths
+is a finding. ``fold_in(key, i)`` and key construction are non-consuming —
+``fold_in`` with varying data is exactly the sanctioned loop idiom
+(``ops/sweep.py`` uses it per budget rung). Nested functions are analyzed
+separately with their own parameters; closure-captured keys are not
+tracked across that boundary.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from hpbandster_tpu.analysis.core import Finding, Rule, SourceModule, register
+from hpbandster_tpu.analysis.rules._util import ImportMap, dotted_name, import_map_for
+
+#: jax.random.* callables that do NOT consume their key argument
+_NON_CONSUMING = {"key", "PRNGKey", "wrap_key_data", "key_data", "fold_in", "clone", "key_impl"}
+
+
+class _Use:
+    __slots__ = ("node", "branch", "loops")
+
+    def __init__(self, node: ast.AST, branch: Dict[int, int], loops: Tuple[int, ...]):
+        self.node = node
+        self.branch = dict(branch)
+        self.loops = loops
+
+
+def _branches_compatible(a: Dict[int, int], b: Dict[int, int]) -> bool:
+    """False when the two uses sit in mutually exclusive arms of some If."""
+    return all(b[k] == v for k, v in a.items() if k in b)
+
+
+def _terminates(body: List[ast.stmt]) -> bool:
+    """True when control cannot fall off the end of ``body``."""
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue)
+    )
+
+
+@register
+class PRNGReuseRule(Rule):
+    name = "prng-reuse"
+    description = (
+        "jax.random key reused, consumed stale inside a loop, or split() "
+        "entropy discarded"
+    )
+
+    def check(self, module: SourceModule) -> List[Finding]:
+        # sound prefilter: consumption sites resolve through a jax import
+        if "jax" not in module.text:
+            return []
+        imports = import_map_for(module)
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(_FunctionScan(self, module, imports, node).scan())
+        return findings
+
+
+class _FunctionScan:
+    def __init__(
+        self,
+        rule: PRNGReuseRule,
+        module: SourceModule,
+        imports: ImportMap,
+        fn: ast.AST,
+    ):
+        self.rule = rule
+        self.module = module
+        self.imports = imports
+        self.fn = fn
+        self.env: Dict[str, int] = {}
+        self.uses: Dict[int, List[_Use]] = {}
+        #: version -> loop-nest (tuple of loop node ids) at creation time
+        self.created_in: Dict[int, Tuple[int, ...]] = {}
+        self.version_name: Dict[int, str] = {}
+        self._next_version = 0
+        self.branch: Dict[int, int] = {}
+        self.loops: List[ast.AST] = []
+        self.findings: List[Finding] = []
+        #: versions already reported (one finding per reuse chain, not N²)
+        self._reported: Set[int] = set()
+
+    # ------------------------------------------------------------- plumbing
+    def _fresh(self, name: str) -> int:
+        v = self._next_version
+        self._next_version += 1
+        self.env[name] = v
+        self.created_in[v] = tuple(id(l) for l in self.loops)
+        self.version_name[v] = name
+        return v
+
+    def _random_callee(self, call: ast.Call) -> Optional[str]:
+        """'split' / 'uniform' / ... when ``call`` targets jax.random, else None."""
+        resolved = self.imports.resolve(call.func)
+        if resolved is None:
+            return None
+        if resolved.startswith("jax.random."):
+            return resolved[len("jax.random."):]
+        return None
+
+    # ----------------------------------------------------------------- scan
+    def scan(self) -> List[Finding]:
+        self._seed_params()
+        self._stmts(getattr(self.fn, "body", []))
+        return self.findings
+
+    def _seed_params(self) -> None:
+        args = self.fn.args
+        for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+            lowered = a.arg.lower()
+            annotation = ast.dump(a.annotation) if a.annotation is not None else ""
+            if "key" in lowered or lowered in ("rng", "prng") or "PRNGKey" in annotation:
+                self._fresh(a.arg)
+
+    def _stmts(self, body: List[ast.stmt]) -> None:
+        for i, stmt in enumerate(body):
+            # guard-clause idiom: `if c: return use(key)` followed by
+            # `use(key)` is branch-exclusive — treat the remainder of the
+            # block as the else arm
+            if (
+                isinstance(stmt, ast.If)
+                and not stmt.orelse
+                and _terminates(stmt.body)
+            ):
+                self._record_uses(stmt.test)
+                self._branched(stmt, stmt.body, body[i + 1:])
+                return
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # separate scope, scanned on its own
+        if isinstance(stmt, ast.Assign):
+            self._record_uses(stmt.value)
+            self._assign(stmt.targets, stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            self._record_uses(stmt.value)
+            name = dotted_name(stmt.target)
+            if name in self.env:
+                self._fresh(name)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._record_uses(stmt.value)
+                self._assign([stmt.target], stmt.value)
+        elif isinstance(stmt, ast.Expr):
+            self._record_uses(stmt.value)
+            call = stmt.value
+            if isinstance(call, ast.Call) and self._random_callee(call) == "split":
+                self.findings.append(
+                    self.rule.finding(
+                        self.module, call, "split() result discarded — the fresh "
+                        "subkeys are lost and the parent key is still live",
+                    )
+                )
+        elif isinstance(stmt, ast.If):
+            self._record_uses(stmt.test)
+            self._branched(stmt, stmt.body, stmt.orelse)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._record_uses(stmt.iter)
+            self._loop(stmt, stmt.body, stmt.orelse, target=stmt.target)
+        elif isinstance(stmt, ast.While):
+            self._record_uses(stmt.test)
+            self._loop(stmt, stmt.body, stmt.orelse, target=None)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._record_uses(item.context_expr)
+            self._stmts(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self._stmts(stmt.body)
+            for handler in stmt.handlers:
+                self._stmts(handler.body)
+            self._stmts(stmt.orelse)
+            self._stmts(stmt.finalbody)
+        elif isinstance(stmt, ast.Return) and stmt.value is not None:
+            self._record_uses(stmt.value)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._record_uses(child)
+
+    # ------------------------------------------------------------- branches
+    def _branched(self, node: ast.If, body: List[ast.stmt], orelse: List[ast.stmt]) -> None:
+        snapshot = dict(self.env)
+        self.branch[id(node)] = 0
+        self._stmts(body)
+        env_body = self.env
+        self.env = dict(snapshot)
+        self.branch[id(node)] = 1
+        self._stmts(orelse)
+        env_else = self.env
+        del self.branch[id(node)]
+        # merge: any name rebound in either arm (relative to the snapshot)
+        # gets a fresh join version; untouched names keep their pre-branch
+        # version so reuse across the If is still caught
+        rebound = {
+            name
+            for name in set(env_body) | set(env_else)
+            if env_body.get(name) != snapshot.get(name)
+            or env_else.get(name) != snapshot.get(name)
+        }
+        self.env = dict(snapshot)
+        for name in sorted(rebound):
+            self._fresh(name)
+
+    def _loop(
+        self,
+        node: ast.stmt,
+        body: List[ast.stmt],
+        orelse: List[ast.stmt],
+        target: Optional[ast.expr],
+    ) -> None:
+        if target is not None:
+            for n in ast.walk(target):
+                if isinstance(n, ast.Name) and n.id in self.env:
+                    self._fresh(n.id)
+        self.loops.append(node)
+        self._stmts(body)
+        self.loops.pop()
+        # names rebound inside the loop are unknowable after it
+        for name, ver in list(self.env.items()):
+            if self.created_in.get(ver, ()) and id(node) in self.created_in[ver]:
+                self._fresh(name)
+        self._stmts(orelse)
+
+    # ----------------------------------------------------------------- uses
+    def _assign(self, targets: List[ast.expr], value: ast.expr) -> None:
+        is_split = isinstance(value, ast.Call) and self._random_callee(value) in (
+            "split",
+            "key",
+            "PRNGKey",
+            "fold_in",
+            "wrap_key_data",
+            "clone",
+        )
+        for tgt in targets:
+            elements = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) else [tgt]
+            for el in elements:
+                if isinstance(el, ast.Starred):
+                    el = el.value
+                name = dotted_name(el)
+                if name is None:
+                    continue
+                if is_split:
+                    if name == "_":
+                        self.findings.append(
+                            self.rule.finding(
+                                self.module, el, "split() result partially discarded "
+                                "into '_' — drop the split width instead of entropy",
+                            )
+                        )
+                        continue
+                    self._fresh(name)
+                elif name in self.env:
+                    self._fresh(name)  # rebound to something else: new version
+
+    def _record_uses(self, expr: ast.expr) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = self._random_callee(node)
+            if callee is None or callee in _NON_CONSUMING:
+                continue
+            if not node.args:
+                continue
+            key_name = dotted_name(node.args[0])
+            if key_name is None or key_name not in self.env:
+                continue
+            version = self.env[key_name]
+            use = _Use(node, self.branch, tuple(id(l) for l in self.loops))
+            prior = self.uses.setdefault(version, [])
+            self._check_loop_staleness(key_name, version, use)
+            for p in prior:
+                if version in self._reported:
+                    break
+                if _branches_compatible(p.branch, use.branch):
+                    self.findings.append(
+                        self.rule.finding(
+                            self.module, node,
+                            f"PRNG key {key_name!r} reused — already consumed at "
+                            f"line {p.node.lineno}; split first, then consume each "
+                            "subkey exactly once",
+                        )
+                    )
+                    self._reported.add(version)
+                    break
+            prior.append(use)
+
+    def _check_loop_staleness(self, name: str, version: int, use: _Use) -> None:
+        """A key created outside the current loop nest, consumed inside it,
+        with no reassignment of the name anywhere in the innermost loop body,
+        redraws identical randomness every iteration."""
+        if not self.loops or version in self._reported:
+            return
+        created = self.created_in.get(version, ())
+        current = use.loops
+        if created == current or current[: len(created)] != created:
+            return  # created in this nest (or weirdness): the carry idiom
+        innermost = self.loops[-1]
+        for n in ast.walk(innermost):
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                tgts = n.targets if isinstance(n, ast.Assign) else [n.target]
+                for tgt in tgts:
+                    for el in ast.walk(tgt):
+                        if dotted_name(el) == name:
+                            return
+        self.findings.append(
+            self.rule.finding(
+                self.module, use.node,
+                f"PRNG key {name!r} was created outside this loop and is "
+                "consumed every iteration without a split — each pass redraws "
+                "identical randomness (fold_in(key, i) or split per iteration)",
+            )
+        )
+        self._reported.add(version)

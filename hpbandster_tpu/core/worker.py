@@ -23,7 +23,7 @@ import time
 import traceback
 from typing import Any, Dict, Optional
 
-from hpbandster_tpu.parallel.rpc import RPCProxy, RPCServer
+from hpbandster_tpu.parallel.rpc import RPCProxy, RPCServer, format_uri
 
 __all__ = ["Worker"]
 
@@ -94,7 +94,7 @@ class Worker:
         self._extra_rpc(self._server)
         self._server.start()
 
-        ns = RPCProxy(f"{self.nameserver}:{self.nameserver_port}")
+        ns = RPCProxy(format_uri(self.nameserver, self.nameserver_port))
         ns.call("register", name=self.worker_id, uri=self._server.uri)
         self.logger.info(
             "worker %s serving at %s", self.worker_id, self._server.uri
@@ -120,10 +120,11 @@ class Worker:
 
     def _teardown(self) -> None:
         try:
-            ns = RPCProxy(f"{self.nameserver}:{self.nameserver_port}", timeout=2)
+            ns = RPCProxy(format_uri(self.nameserver, self.nameserver_port), timeout=2)
             ns.call("unregister", name=self.worker_id)
-        except Exception:
-            pass
+        except Exception as e:
+            # best-effort: the nameserver may already be gone at teardown
+            self.logger.debug("unregister from nameserver failed: %r", e)
         if self._server is not None:
             self._server.shutdown()
             self._server = None

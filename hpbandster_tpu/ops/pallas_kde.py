@@ -47,7 +47,9 @@ def pallas_available() -> bool:
     """Pallas TPU lowering requires a TPU-family backend."""
     try:
         platform = jax.devices()[0].platform.lower()
-    except Exception:
+    # capability probe: ANY failure (no backend, uninitialized runtime)
+    # means "not available", and the caller falls back to the XLA scorer
+    except Exception:  # graftlint: disable=swallowed-exception
         return False
     return platform in ("tpu", "axon")
 

@@ -39,7 +39,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from hpbandster_tpu.core.worker import Worker
-from hpbandster_tpu.parallel.rpc import CommunicationError, RPCError, RPCProxy, RPCServer
+from hpbandster_tpu.parallel.rpc import (
+    CommunicationError,
+    RPCError,
+    RPCProxy,
+    RPCServer,
+    format_uri,
+)
 
 __all__ = ["TPUBatchedWorker", "RPCBatchBackend"]
 
@@ -218,17 +224,22 @@ class RPCBatchBackend:
 
     def refresh_workers(self, force: bool = False) -> None:
         now = time.time()
-        if not force and now - self._last_refresh < self.refresh_interval:
-            return
+        with self._lock:
+            if not force and now - self._last_refresh < self.refresh_interval:
+                return
+            # claim the slot before the (slow, unlocked) nameserver RPC so a
+            # concurrent caller inside the same tick skips instead of issuing
+            # a duplicate listing; an unreachable nameserver then also backs
+            # off for one interval rather than re-stalling the hot path
+            self._last_refresh = now
         try:
             listing = RPCProxy(
-                f"{self.nameserver}:{self.nameserver_port}", timeout=5
+                format_uri(self.nameserver, self.nameserver_port), timeout=5
             ).call("list", prefix=self._prefix)
         except (CommunicationError, RPCError) as e:
             self.logger.warning("nameserver unreachable: %r", e)
             return
         with self._lock:
-            self._last_refresh = now
             gone = set(self._workers) - set(listing)
             for name in gone:
                 self.logger.info("batched worker %s left the pool", name)

@@ -23,6 +23,7 @@ from hpbandster_tpu.parallel.rpc import (
     RPCError,
     RPCProxy,
     RPCServer,
+    format_uri,
 )
 
 __all__ = ["Dispatcher", "WorkerProxy"]
@@ -63,7 +64,7 @@ class Dispatcher:
         logger: Optional[logging.Logger] = None,
     ):
         self.run_id = run_id
-        self.nameserver_uri = f"{nameserver}:{nameserver_port}"
+        self.nameserver_uri = format_uri(nameserver, nameserver_port)
         self.host = host or "127.0.0.1"
         self.ping_interval = ping_interval
         self.discover_interval = discover_interval
@@ -199,7 +200,8 @@ class Dispatcher:
 
     # ------------------------------------------------------------ job runner
     def _idle_worker(self) -> Optional[WorkerProxy]:
-        for w in self.workers.values():
+        # sole caller is _job_runner_loop, inside `with self._cond:`
+        for w in self.workers.values():  # graftlint: disable=lock-coverage
             if w.runs_job is None:
                 return w
         return None

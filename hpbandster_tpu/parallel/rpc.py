@@ -19,11 +19,34 @@ import threading
 import traceback
 from typing import Any, Callable, Dict, Optional, Tuple
 
-__all__ = ["RPCServer", "RPCProxy", "RPCError", "CommunicationError"]
+__all__ = ["RPCServer", "RPCProxy", "RPCError", "CommunicationError", "parse_uri", "format_uri"]
 
 logger = logging.getLogger("hpbandster_tpu.rpc")
 
 _MAX_FRAME = 64 * 1024 * 1024  # 64 MiB per message
+
+
+def parse_uri(uri: str) -> Tuple[str, int]:
+    """``host:port`` -> ``(host, port)``, RFC 3986 bracket form included.
+
+    ``[::1]:9090`` parses to ``('::1', 9090)`` — a plain ``rsplit(':')``
+    would split inside the address. Bare IPv6 without brackets is rejected
+    (ambiguous: every colon is a candidate separator).
+    """
+    if uri.startswith("["):
+        host, sep, port = uri[1:].rpartition("]:")
+        if not sep or not port:
+            raise ValueError(f"malformed bracketed uri {uri!r}")
+        return host, int(port)
+    host, sep, port = uri.rpartition(":")
+    if not sep or ":" in host:
+        raise ValueError(f"malformed uri {uri!r} (bracket IPv6 hosts: '[::1]:9090')")
+    return host, int(port)
+
+
+def format_uri(host: str, port: int) -> str:
+    """Inverse of :func:`parse_uri`: brackets IPv6 hosts."""
+    return f"[{host}]:{port}" if ":" in host else f"{host}:{port}"
 
 
 class RPCError(Exception):
@@ -77,12 +100,22 @@ class _ThreadingTCPServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
 
 
+class _ThreadingTCP6Server(_ThreadingTCPServer):
+    address_family = socket.AF_INET6
+
+
 class RPCServer:
-    """Serve a dict of callables over TCP; one daemon thread per connection."""
+    """Serve a dict of callables over TCP; one daemon thread per connection.
+
+    IPv6 hosts (any host containing ':') bind an AF_INET6 socket and render
+    their :attr:`uri` in bracket form, round-tripping through
+    :func:`parse_uri` on the proxy side.
+    """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.methods: Dict[str, Callable[..., Any]] = {}
-        self._server = _ThreadingTCPServer((host, port), _Handler)
+        server_cls = _ThreadingTCP6Server if ":" in host else _ThreadingTCPServer
+        self._server = server_cls((host, port), _Handler)
         self._server.methods = self.methods  # type: ignore[attr-defined]
         self.host, self.port = self._server.server_address[:2]
         self._thread: Optional[threading.Thread] = None
@@ -101,7 +134,7 @@ class RPCServer:
 
     @property
     def uri(self) -> str:
-        return f"{self.host}:{self.port}"
+        return format_uri(self.host, self.port)
 
     def start(self) -> "RPCServer":
         self._thread = threading.Thread(
@@ -122,8 +155,7 @@ class RPCProxy:
     """Call methods on a remote RPCServer; connection per call."""
 
     def __init__(self, uri: str, timeout: float = 10.0):
-        host, port = uri.rsplit(":", 1)
-        self.addr: Tuple[str, int] = (host, int(port))
+        self.addr: Tuple[str, int] = parse_uri(uri)
         self.uri = uri
         self.timeout = timeout
 

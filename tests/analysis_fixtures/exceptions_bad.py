@@ -1,0 +1,34 @@
+"""Known-BAD fixture for the swallowed-exception rule."""
+
+
+def swallow_with_pass():
+    try:
+        risky()
+    except Exception:  # BAD
+        pass
+
+
+def swallow_bare():
+    try:
+        risky()
+    except:  # BAD
+        return None
+
+
+def swallow_inside_tuple():
+    try:
+        risky()
+    except (ValueError, Exception):  # BAD
+        return -1
+
+
+def swallow_base_exception():
+    try:
+        risky()
+    except BaseException:  # BAD
+        result = "fine"
+        return result
+
+
+def risky():
+    raise RuntimeError("boom")

@@ -1,0 +1,54 @@
+"""Known-GOOD fixture for the swallowed-exception rule: every sanctioned
+way of catching broadly — plus narrow handlers, which are never flagged."""
+
+import logging
+import traceback
+
+logger = logging.getLogger(__name__)
+
+
+def narrow_handler():
+    try:
+        risky()
+    except (ValueError, KeyError):
+        return None  # naming the failure mode IS handling it
+
+
+def logs_it():
+    try:
+        risky()
+    except Exception:
+        logger.exception("risky failed")
+
+
+def reraises():
+    try:
+        risky()
+    except Exception:
+        raise
+
+
+def marshals_it():
+    try:
+        risky()
+    except Exception as e:
+        return {"error": repr(e)}
+
+
+def formats_traceback():
+    try:
+        risky()
+    except Exception:
+        return {"error": traceback.format_exc()}
+
+
+def justified_probe():
+    try:
+        return risky()
+    # capability probe: absence of the feature is the answer, not an error
+    except Exception:  # graftlint: disable=swallowed-exception
+        return False
+
+
+def risky():
+    raise RuntimeError("boom")

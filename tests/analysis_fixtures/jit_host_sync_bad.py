@@ -1,0 +1,45 @@
+"""Known-BAD fixture for the jit-host-sync rule.
+
+Never imported — parsed by graftlint in the rule tests only. Every line
+ending in ``# BAD`` must be flagged, and no other line may be.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def float_on_traced(x):
+    y = jnp.sum(x)
+    return float(y)  # BAD
+
+
+@partial(jax.jit, static_argnames=("n",))
+def numpy_sink_on_traced(x, n):
+    total = x * n
+    host = np.asarray(total)  # BAD
+    return host
+
+
+def branch_on_traced(v):
+    s = v.sum()
+    if s > 0:  # BAD
+        return s
+    return -s
+
+
+branch_jitted = jax.jit(branch_on_traced)
+
+
+@jax.jit
+def item_leak(x):
+    return x.item()  # BAD
+
+
+@jax.jit
+def device_get_leak(x):
+    pulled = jax.device_get(x)  # BAD
+    return pulled

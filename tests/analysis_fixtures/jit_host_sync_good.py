@@ -1,0 +1,45 @@
+"""Known-GOOD fixture for the jit-host-sync rule: traced code with only
+legitimate host arithmetic, plus one justified suppression."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BUDGETS = (1.0, 3.0, 9.0)
+
+
+@jax.jit
+def pure_kernel(x):
+    return jnp.tanh(x) * 2.0
+
+
+@partial(jax.jit, static_argnames=("n",))
+def static_arg_is_concrete(x, n):
+    scale = float(n)  # static_argnames: n is a Python int at trace time
+    return x * scale
+
+
+@partial(jax.jit, static_argnums=(1,))
+def static_argnum_counts_posonly(x, /, n):
+    # argnum 1 is `n` even with a positional-only parameter ahead of it
+    return x * float(n)
+
+
+@jax.jit
+def closure_constants_are_static(x):
+    return x * float(BUDGETS[0])
+
+
+def host_side_helper(rows):
+    # not jitted anywhere: plain host numpy is fine
+    arr = np.asarray(rows, np.float32)
+    return float(arr.sum())
+
+
+@jax.jit
+def justified_escape(x):
+    y = jnp.max(x)
+    # deliberate trace-time constant fold: y is data-independent here
+    return float(y)  # graftlint: disable=jit-host-sync

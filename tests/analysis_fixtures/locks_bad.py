@@ -1,0 +1,42 @@
+"""Known-BAD fixture for the lock-coverage rule: guarded state, naked access."""
+
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.jobs = {}
+        self.capacity = 4
+
+    def add(self, name, job):
+        with self._lock:
+            self.jobs[name] = job
+
+    def steal(self, name):
+        return self.jobs.pop(name, None)  # BAD
+
+    def resize(self, n):
+        with self._lock:
+            self.capacity = n
+
+    def report(self):
+        n = len(self.jobs)  # BAD
+        return n, self.capacity  # BAD
+
+
+class CondQueue:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.queue = []
+
+    def put(self, item):
+        with self._cond:
+            self.queue = self.queue + [item]
+            self._cond.notify_all()
+
+    def drain(self):
+        out = list(self.queue)  # BAD
+        with self._cond:
+            self.queue = []
+        return out

@@ -1,0 +1,53 @@
+"""Known-GOOD fixture for the lock-coverage rule: disciplined locking,
+construction-time stores, and one justified caller-holds-the-lock helper."""
+
+import threading
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = {}  # __init__: the object is not shared yet
+
+    def update(self, k, v):
+        with self._lock:
+            self.state[k] = v
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self.state)
+
+    def _len_locked(self):
+        # sole caller is snapshot-like code inside `with self._lock:`
+        return len(self.state)  # graftlint: disable=lock-coverage
+
+
+class TwoLocks:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self.results = []
+
+    def push(self, r):
+        with self._cond:
+            self.results = self.results + [r]
+
+    def swap(self):
+        with self._cond:
+            out, self.results = self.results, []
+        return out
+
+
+class UnsharedList:
+    """Method-call mutations alone never define a protected set."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.log = []
+
+    def append(self, x):
+        self.log.append(x)
+
+    def locked_op(self):
+        with self._lock:
+            return 42

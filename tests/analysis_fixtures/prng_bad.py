@@ -1,0 +1,33 @@
+"""Known-BAD fixture for the prng-reuse rule: every classic key misuse."""
+
+import jax
+
+
+def reuse_same_key(key):
+    a = jax.random.uniform(key, (3,))
+    b = jax.random.normal(key, (3,))  # BAD
+    return a + b
+
+
+def reuse_a_subkey(key):
+    k1, k2 = jax.random.split(key)
+    x = jax.random.uniform(k1)
+    y = jax.random.uniform(k1)  # BAD
+    return x + y + jax.random.uniform(k2)
+
+
+def stale_key_in_loop(key, n):
+    total = 0.0
+    for _ in range(n):
+        total = total + jax.random.uniform(key)  # BAD
+    return total
+
+
+def discarded_split(key):
+    jax.random.split(key)  # BAD
+    return jax.random.uniform(key)  # BAD
+
+
+def partially_discarded_split(key):
+    k1, _ = jax.random.split(key)  # BAD
+    return jax.random.uniform(k1)

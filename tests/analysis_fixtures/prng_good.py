@@ -1,0 +1,54 @@
+"""Known-GOOD fixture for the prng-reuse rule: the sanctioned idioms."""
+
+import jax
+
+
+def split_then_use(key):
+    k1, k2 = jax.random.split(key)
+    return jax.random.uniform(k1) + jax.random.normal(k2, ())
+
+
+def fold_in_loop(key, n):
+    # fold_in with varying data is THE loop idiom (ops/sweep.py uses it)
+    total = 0.0
+    for i in range(n):
+        total = total + jax.random.uniform(jax.random.fold_in(key, i))
+    return total
+
+
+def carry_idiom(key, n):
+    out = []
+    for _ in range(n):
+        key, sub = jax.random.split(key)
+        out.append(jax.random.uniform(sub))
+    return out
+
+
+def branch_exclusive_arms(key, flag):
+    if flag:
+        return jax.random.uniform(key)
+    return jax.random.normal(key, ())
+
+
+def wide_split(key, n):
+    keys = jax.random.split(key, n)
+    return keys
+
+
+def rebind_in_both_arms(key, flag):
+    # both arms rebind `key`; the merged version after the If is fresh, so
+    # the final consumption is that version's first use — regardless of the
+    # variable names' hash order (regression: order-dependent branch merge)
+    if flag:
+        key, a = jax.random.split(key)
+        out = jax.random.uniform(a)
+    else:
+        key, b = jax.random.split(key)
+        out = jax.random.normal(b, ())
+    return out + jax.random.uniform(key)
+
+
+class KeyChain:
+    def next_key(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub

@@ -1,0 +1,24 @@
+"""Known-BAD fixture for the pytest-marker rule (named test_* so the rule
+fires; pytest itself never collects this directory)."""
+
+import jax
+import pytest
+
+
+def test_pmap_unmarked():  # BAD
+    fn = jax.pmap(lambda x: x * 2)
+    fn(None)
+
+
+def test_many_brackets_unmarked(opt=None):  # BAD
+    opt.run(n_iterations=64, min_n_workers=1)
+
+
+def test_huge_budget_unmarked(make_opt=None):  # BAD
+    make_opt(min_budget=1, max_budget=729)
+
+
+class TestUnmarkedClass:
+    def test_jit_in_wide_loop(self):  # BAD
+        for i in range(100):
+            jax.jit(lambda x: x)(i)

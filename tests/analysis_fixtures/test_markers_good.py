@@ -1,0 +1,39 @@
+"""Known-GOOD fixture for the pytest-marker rule: fast tests under the
+thresholds, and heavy tests correctly marked slow."""
+
+import jax
+import pytest
+
+
+def test_small_and_fast():
+    assert jax.numpy.add(1, 1) == 2
+
+
+def test_modest_iterations(opt=None):
+    opt.run(n_iterations=4, min_n_workers=1)
+
+
+def test_modest_budget(make_opt=None):
+    make_opt(min_budget=1, max_budget=81)
+
+
+def test_short_jit_loop():
+    for i in range(8):
+        jax.jit(lambda x: x)(i)
+
+
+@pytest.mark.slow
+def test_pmap_marked():
+    jax.pmap(lambda x: x)(None)
+
+
+@pytest.mark.slow
+def test_many_brackets_marked(opt=None):
+    opt.run(n_iterations=64)
+
+
+class TestMarkedClass:
+    pytestmark = pytest.mark.slow
+
+    def test_pmap_under_class_mark(self):
+        jax.pmap(lambda x: x)(None)

@@ -1,0 +1,132 @@
+"""Per-rule graftlint fixture tests.
+
+Each rule has a known-bad and a known-good module in
+``tests/analysis_fixtures/`` (excluded from both the default graftlint walk
+and pytest collection). Every known-bad line carries a trailing ``# BAD``
+marker; the test asserts the rule reports exactly those ``file:line``
+locations — nothing missed, nothing extra. Known-good modules (the
+sanctioned idioms plus one justified suppression each) must be silent.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from hpbandster_tpu.analysis import run
+
+FIXTURES = Path(__file__).parent / "analysis_fixtures"
+
+CASES = [
+    ("jit-host-sync", "jit_host_sync_bad.py", "jit_host_sync_good.py"),
+    ("prng-reuse", "prng_bad.py", "prng_good.py"),
+    ("lock-coverage", "locks_bad.py", "locks_good.py"),
+    ("swallowed-exception", "exceptions_bad.py", "exceptions_good.py"),
+    ("pytest-marker", "test_markers_bad.py", "test_markers_good.py"),
+]
+
+
+def expected_bad_lines(path: Path) -> set:
+    return {
+        i
+        for i, line in enumerate(path.read_text().splitlines(), start=1)
+        if line.rstrip().endswith("# BAD")
+    }
+
+
+@pytest.mark.parametrize(("rule", "bad", "good"), CASES, ids=[c[0] for c in CASES])
+class TestRuleFixtures:
+    def test_bad_fixture_caught_at_exact_lines(self, rule, bad, good):
+        path = FIXTURES / bad
+        expected = expected_bad_lines(path)
+        assert expected, f"fixture {bad} has no # BAD markers"
+        findings = run([str(path)], rules=[rule])
+        assert all(f.rule == rule for f in findings)
+        assert all(f.path == str(path) for f in findings)
+        got = {f.line for f in findings}
+        missing = expected - got
+        extra = got - expected
+        assert got == expected, (
+            f"missed lines {sorted(missing)}, extra lines {sorted(extra)}:\n"
+            + "\n".join(str(f) for f in findings)
+        )
+
+    def test_good_fixture_is_clean(self, rule, bad, good):
+        path = FIXTURES / good
+        findings = run([str(path)], rules=[rule])
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+
+class TestSuppressions:
+    def test_standalone_comment_suppresses_next_line(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text(
+            "def f():\n"
+            "    try:\n"
+            "        pass\n"
+            "    # probe, absence is the answer\n"
+            "    # graftlint: disable=swallowed-exception\n"
+            "    except Exception:\n"
+            "        pass\n"
+        )
+        assert run([str(mod)], rules=["swallowed-exception"]) == []
+
+    def test_disable_all(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text(
+            "def f():\n"
+            "    try:\n"
+            "        pass\n"
+            "    except Exception:  # graftlint: disable=all\n"
+            "        pass\n"
+        )
+        assert run([str(mod)]) == []
+
+    def test_trailing_directive_on_multiline_statement(self, tmp_path):
+        # the finding anchors to the statement's FIRST line; a directive on
+        # any later physical line of the same logical line must still cover it
+        mod = tmp_path / "mod.py"
+        mod.write_text(
+            "import jax\n"
+            "\n"
+            "def f(key):\n"
+            "    jax.random.split(\n"
+            "        key,\n"
+            "        2,\n"
+            "    )  # graftlint: disable=prng-reuse — demo of wrapped-call suppression\n"
+            "    return None\n"
+        )
+        assert run([str(mod)], rules=["prng-reuse"]) == []
+
+    def test_unrelated_rule_does_not_suppress(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text(
+            "def f():\n"
+            "    try:\n"
+            "        pass\n"
+            "    except Exception:  # graftlint: disable=prng-reuse\n"
+            "        pass\n"
+        )
+        findings = run([str(mod)], rules=["swallowed-exception"])
+        assert len(findings) == 1
+
+
+class TestRunner:
+    def test_unknown_rule_raises(self):
+        with pytest.raises(KeyError, match="no-such-rule"):
+            run([str(FIXTURES)], rules=["no-such-rule"])
+
+    def test_syntax_error_is_a_parse_error_finding(self, tmp_path):
+        mod = tmp_path / "broken.py"
+        mod.write_text("def f(:\n")
+        findings = run([str(mod)])
+        assert [f.rule for f in findings] == ["parse-error"]
+
+    def test_fixture_dir_skipped_by_default_walk(self):
+        findings = run([str(FIXTURES.parent)], rules=["swallowed-exception"])
+        fixture_hits = [f for f in findings if "analysis_fixtures" in f.path]
+        assert fixture_hits == []
+
+    def test_nonexistent_path_trips_the_gate(self):
+        findings = run(["definitely/not/a/path"])
+        assert [f.rule for f in findings] == ["parse-error"]
+        assert "does not exist" in findings[0].message

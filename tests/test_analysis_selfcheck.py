@@ -1,0 +1,73 @@
+"""The repo gates itself on graftlint (fast lane, < 5 s, no jax import).
+
+Tier-1 guarantee: ``python -m hpbandster_tpu.analysis hpbandster_tpu tests``
+exits 0 on the committed tree, and exits non-zero the moment any rule's
+known-bad fixture (or code like it) is introduced.
+"""
+
+import shutil
+import time
+from pathlib import Path
+
+import pytest
+
+from hpbandster_tpu.analysis import all_rules, format_report, run
+from hpbandster_tpu.analysis.__main__ import main
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).parent / "analysis_fixtures"
+SCAN = [str(REPO / "hpbandster_tpu"), str(REPO / "tests")]
+
+RULE_TO_BAD_FIXTURE = {
+    "jit-host-sync": "jit_host_sync_bad.py",
+    "prng-reuse": "prng_bad.py",
+    "lock-coverage": "locks_bad.py",
+    "swallowed-exception": "exceptions_bad.py",
+    "pytest-marker": "test_markers_bad.py",
+}
+
+
+def test_rule_pack_is_registered():
+    assert set(RULE_TO_BAD_FIXTURE) <= set(all_rules())
+
+
+def test_repo_tree_is_clean():
+    findings = run(SCAN)
+    assert findings == [], "\n" + format_report(findings)
+
+
+def test_cli_exits_zero_on_clean_tree(capsys):
+    assert main(SCAN) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_exits_nonzero_when_bad_fixture_introduced(tmp_path, capsys):
+    """Acceptance criterion: drop any known-bad fixture into a scanned tree
+    and the gate must trip, attributed to the right rule."""
+    for rule, fixture in RULE_TO_BAD_FIXTURE.items():
+        tree = tmp_path / rule
+        tree.mkdir()
+        shutil.copy(FIXTURES / fixture, tree / fixture)
+        assert main([str(tree)]) == 1, f"{fixture} did not trip the gate"
+        out = capsys.readouterr().out
+        assert f"[{rule}]" in out, f"{fixture} tripped the wrong rule:\n{out}"
+
+
+def test_cli_list_rules(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in RULE_TO_BAD_FIXTURE:
+        assert rule in out
+
+
+def test_cli_unknown_rule_is_usage_error(capsys):
+    assert main(["--rules", "definitely-not-a-rule", str(FIXTURES)]) == 2
+
+
+def test_selfcheck_is_fast_lane_material():
+    """The gate must stay cheap enough to run on every PR: a full scan of
+    both trees in well under the 5 s budget."""
+    t0 = time.perf_counter()
+    run(SCAN)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 5.0, f"graftlint scan took {elapsed:.2f}s"

@@ -1,5 +1,6 @@
 """Unit tests for the TCP RPC transport (the Pyro4-replacement layer)."""
 
+import socket
 import threading
 
 import pytest
@@ -9,6 +10,8 @@ from hpbandster_tpu.parallel.rpc import (
     RPCError,
     RPCProxy,
     RPCServer,
+    format_uri,
+    parse_uri,
 )
 
 
@@ -83,6 +86,72 @@ class TestRPC:
             assert RPCProxy(srv.uri).call("ping") == "pong"
             with pytest.raises(RPCError, match="unknown method"):
                 RPCProxy(srv.uri).call("_private")
+        finally:
+            srv.shutdown()
+
+
+def _ipv6_loopback_available() -> bool:
+    if not socket.has_ipv6:
+        return False
+    try:
+        s = socket.socket(socket.AF_INET6, socket.SOCK_STREAM)
+        try:
+            s.bind(("::1", 0))
+        finally:
+            s.close()
+        return True
+    except OSError:
+        return False
+
+
+class TestURIParsing:
+    def test_ipv4(self):
+        assert parse_uri("127.0.0.1:9090") == ("127.0.0.1", 9090)
+
+    def test_hostname(self):
+        assert parse_uri("worker-3.local:80") == ("worker-3.local", 80)
+
+    def test_bracketed_ipv6(self):
+        assert parse_uri("[::1]:9090") == ("::1", 9090)
+        assert parse_uri("[fe80::a:b]:1234") == ("fe80::a:b", 1234)
+
+    def test_roundtrip_through_format(self):
+        for host, port in [("::1", 9090), ("10.0.0.2", 80), ("fe80::1", 1)]:
+            assert parse_uri(format_uri(host, port)) == (host, port)
+
+    def test_bare_ipv6_rejected(self):
+        # every colon is a candidate separator — must be bracketed
+        with pytest.raises(ValueError, match="bracket"):
+            parse_uri("::1:9090")
+
+    def test_malformed_bracket_rejected(self):
+        with pytest.raises(ValueError, match="malformed"):
+            parse_uri("[::1]")
+
+    def test_proxy_parses_bracketed_uri(self):
+        proxy = RPCProxy("[::1]:9090", timeout=1)
+        assert proxy.addr == ("::1", 9090)
+
+    def test_internal_uri_builders_bracket_ipv6(self):
+        # every internal nameserver-URI construction must round-trip IPv6
+        # through format_uri (a bare f"{host}:{port}" would build '::1:9090',
+        # which parse_uri rightly rejects)
+        from hpbandster_tpu.parallel.dispatcher import Dispatcher
+
+        d = Dispatcher(run_id="uri6", nameserver="::1", nameserver_port=9090)
+        assert d.nameserver_uri == "[::1]:9090"
+        assert parse_uri(d.nameserver_uri) == ("::1", 9090)
+
+    @pytest.mark.skipif(
+        not _ipv6_loopback_available(), reason="no IPv6 loopback on this host"
+    )
+    def test_ipv6_end_to_end(self):
+        srv = RPCServer("::1", 0)
+        srv.register("echo", lambda x: x)
+        srv.start()
+        try:
+            assert srv.uri.startswith("[::1]:")
+            assert RPCProxy(srv.uri).call("echo", x=42) == 42
         finally:
             srv.shutdown()
 
