@@ -65,6 +65,7 @@ HEADLINE_BRACKETS = 27
 TIER_ORDER = (
     "cnn", "cnn_wide", "pallas", "resnet", "transformer", "fused10k",
     "chunked10k", "chunked_compile", "fused", "rpc", "batched", "teacher",
+    "obs_overhead",
 )
 
 #: per-tier sample size after one warmup run (compile excluded). The driver
@@ -688,6 +689,115 @@ def bench_chunked_compile(n_iterations=9, chunk=3, max_budget=9, seed=70,
     }
 
 
+def bench_obs_overhead(repeats=3, n_iterations=3, inner=20, seed=0):
+    """No-sink cost of the always-on obs instrumentation on the batched
+    sweep path (BOHB + BatchedExecutor + VmapBackend on Branin, budgets
+    1..9).
+
+    Headline (``overhead_pct``) is COMPUTED, not raced: (per-call cost of
+    a sinkless emit / counter inc, measured over long loops that average
+    out scheduler noise) x (instrumented calls in one sweep, counted
+    exactly by a counting sink + metric-snapshot delta) / (warm sweep
+    wall). A direct A/B wall-clock comparison rides along as a
+    cross-check (``ab_wall``), but on a shared host its noise floor
+    (measured: adjacent identical blocks varying 2x) sits far above a
+    sub-percent effect — the computed product is the citable number and
+    the reproducible one. Acceptance bar (docs/observability.md): < 2%."""
+    from hpbandster_tpu import obs
+    from hpbandster_tpu.optimizers import BOHB
+    from hpbandster_tpu.parallel import BatchedExecutor, VmapBackend
+    from hpbandster_tpu.workloads.toys import branin_from_vector, branin_space
+
+    def run_once(s):
+        cs = branin_space(seed=s)
+        executor = BatchedExecutor(
+            VmapBackend(branin_from_vector), cs, parallel_brackets=3
+        )
+        opt = BOHB(
+            configspace=cs, run_id=f"bench-obs{s}", executor=executor,
+            min_budget=1, max_budget=9, eta=3, seed=s,
+        )
+        res = opt.run(n_iterations=n_iterations)
+        n = len(res.get_all_runs())
+        opt.shutdown()
+        return n
+
+    # --- micro: per-call cost with no sink attached (long loops: the
+    # per-op signal accumulates far above scheduler noise)
+    bus = obs.EventBus()  # fresh sinkless bus
+    n_micro = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n_micro):
+        bus.emit("job_submitted", config_id=(0, 0, 0), budget=1.0)
+    emit_ns = (time.perf_counter() - t0) / n_micro * 1e9
+    reg = obs.MetricsRegistry()
+    counter = reg.counter("bench")
+    t0 = time.perf_counter()
+    for _ in range(n_micro):
+        counter.inc()
+    counter_ns = (time.perf_counter() - t0) / n_micro * 1e9
+
+    # --- exact instrumented-call census of one sweep
+    events = []
+    detach = obs.get_bus().subscribe(lambda ev: events.append(ev.name))
+    try:
+        snap0 = sum(obs.get_metrics().snapshot()["counters"].values())
+        n_evals = run_once(seed + 7777)
+        snap1 = sum(obs.get_metrics().snapshot()["counters"].values())
+    finally:
+        detach()
+    n_emits = len(events)
+    n_incs = int(snap1 - snap0)
+
+    # --- A/B wall cross-check: paired blocks of pre-warmed sweeps,
+    # alternating arm order
+    def timed_block(enabled, seeds):
+        obs.set_enabled(enabled)
+        try:
+            t0 = time.perf_counter()
+            for s in seeds:
+                run_once(s)
+            return time.perf_counter() - t0
+        finally:
+            obs.set_enabled(True)
+
+    run_once(99)  # process warmup (compile never timed)
+    t_on_total = t_off_total = 0.0
+    for r in range(repeats):
+        seeds = [seed + r * inner + i for i in range(inner)]
+        for s in seeds:
+            run_once(s)
+        order = (True, False) if r % 2 == 0 else (False, True)
+        dt = {}
+        for enabled in order:
+            dt[enabled] = timed_block(enabled, seeds)
+        t_on_total += dt[True]
+        t_off_total += dt[False]
+
+    sweep_s = t_off_total / max(repeats * inner, 1)
+    per_sweep_cost_s = (n_emits * emit_ns + n_incs * counter_ns) / 1e9
+    return {
+        "path": "batched sweep (BOHB + BatchedExecutor, %d brackets, "
+                "budgets 1..9)" % n_iterations,
+        "evaluations_per_sweep": n_evals,
+        "emit_no_sink_ns": round(emit_ns, 1),
+        "counter_inc_ns": round(counter_ns, 1),
+        "instrumented_calls_per_sweep": {"emits": n_emits, "counter_incs": n_incs},
+        "warm_sweep_s": round(sweep_s, 5),
+        "overhead_pct": round(100.0 * per_sweep_cost_s / sweep_s, 3)
+        if sweep_s else None,
+        "ab_wall": {
+            "enabled_no_sink_total_s": round(t_on_total, 4),
+            "disabled_total_s": round(t_off_total, 4),
+            "overhead_pct_of_totals": round(
+                100.0 * (t_on_total - t_off_total) / t_off_total, 2
+            ) if t_off_total else None,
+            "note": "shared-host wall noise floor >> sub-percent effects; "
+                    "cross-check only",
+        },
+    }
+
+
 def _append_partial(path, record, truncate=False):
     """One JSON line per finished tier, flushed + fsynced: the on-disk
     trail survives any way the process dies. ``truncate`` starts a fresh
@@ -801,6 +911,8 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
         pallas = emit("pallas", _run_tier(errors, "pallas",
                                           bench_pallas_scorer,
                                           repeats=repeats))
+        obs_overhead = emit("obs_overhead", _run_tier(
+            errors, "obs_overhead", bench_obs_overhead, repeats=repeats))
     else:
         # evidence-value execution order (TIER_ORDER): the tiers that have
         # never produced a chip number run FIRST, so a driver timeout or a
@@ -933,6 +1045,14 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
             emit("teacher", _run_tier(errors, "teacher", bench_teacher))
             if selected("teacher") else dict(NOT_SELECTED)
         )
+        # backend-independent (the obs layer is host-side either way) and
+        # seconds-scale on CPU, so it measures even on the fallback path —
+        # the overhead claim in docs/observability.md regenerates anywhere
+        obs_overhead = (
+            emit("obs_overhead",
+                 _run_tier(errors, "obs_overhead", bench_obs_overhead))
+            if selected("obs_overhead") else dict(NOT_SELECTED)
+        )
 
     def median_of(tier):
         return tier.get("median") if isinstance(tier, dict) else None
@@ -1017,6 +1137,7 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
             "pallas_scorer_vs_xla": pallas,
             "chunked_compile_static_vs_dynamic": chunked,
             "chunked10k_at_scale_36_brackets_1_729": chunked10k,
+            "obs_overhead_no_sink": obs_overhead,
         },
     }
     if smoke:
@@ -1244,6 +1365,22 @@ def write_baseline(result, path="BASELINE.md", source=None):
                  "(pending a chip run).",
     ))
     lines.append("")
+    lines.append(render(
+        d.get("obs_overhead_no_sink"),
+        lambda x: (
+            "Observability no-sink overhead (%s): %.3f%% — %d emits + %d "
+            "counter incs per sweep at %.0f/%.0f ns each over a %.1f ms "
+            "warm sweep (docs/observability.md; acceptance bar < 2%%)."
+            % (x["path"], x["overhead_pct"],
+               x["instrumented_calls_per_sweep"]["emits"],
+               x["instrumented_calls_per_sweep"]["counter_incs"],
+               x["emit_no_sink_ns"], x["counter_inc_ns"],
+               1e3 * x["warm_sweep_s"])
+        ),
+        fallback="Observability no-sink overhead: not measured in this "
+                 "artifact.",
+    ))
+    lines.append("")
     with open(path) as f:
         text = f.read()
     cut = text.find(BASELINE_MARK)
@@ -1293,7 +1430,8 @@ def compact_line(result, detail_file):
               "transformer_workload_budget_sgd_steps",
               "teacher_workload_budget_epochs", "pallas_scorer_vs_xla",
               "chunked_compile_static_vs_dynamic",
-              "chunked10k_at_scale_36_brackets_1_729"):
+              "chunked10k_at_scale_36_brackets_1_729",
+              "obs_overhead_no_sink"):
         tiers[k] = d.get(k)
     out["tiers_measured"] = sorted(
         k for k, v in tiers.items()

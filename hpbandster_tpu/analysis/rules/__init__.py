@@ -10,5 +10,6 @@ from hpbandster_tpu.analysis.rules import (  # noqa: F401
     jit_purity,
     locks,
     markers,
+    obs_emit,
     prng,
 )

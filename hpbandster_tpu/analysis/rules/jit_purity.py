@@ -63,6 +63,36 @@ def _is_jit_expr(node: ast.AST, imports: ImportMap) -> bool:
     return False
 
 
+def traced_functions(
+    tree: ast.Module, imports: ImportMap
+) -> Dict[ast.FunctionDef, Set[str]]:
+    """Every function the module jits/vmaps/pmaps (decorator or wrapper-call
+    position) -> its static parameter names. Shared by jit-host-sync and
+    obs-emit-in-jit: 'is this body traced?' is one question, answered once."""
+    by_name: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            by_name.setdefault(node.name, []).append(node)
+
+    traced: Dict[ast.FunctionDef, Set[str]] = {}
+
+    def mark(fn: ast.FunctionDef, static: Set[str]) -> None:
+        traced.setdefault(fn, set()).update(static)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                if _is_jit_expr(dec, imports):
+                    mark(node, _static_params(dec, node))
+        if isinstance(node, ast.Call) and _is_jit_expr(node.func, imports):
+            for arg in node.args:
+                for inner in ast.walk(arg):
+                    if isinstance(inner, ast.Name) and inner.id in by_name:
+                        for fn in by_name[inner.id]:
+                            mark(fn, _static_params(node, fn))
+    return traced
+
+
 def _static_params(dec: ast.AST, fn: ast.FunctionDef) -> Set[str]:
     """Parameter names excluded from tracing by static_argnames/argnums."""
     static: Set[str] = set()
@@ -102,38 +132,11 @@ class JitHostSyncRule(Rule):
         if not any(t in module.text for t in ("jit", "pmap", "vmap", "vectorize")):
             return []
         imports = import_map_for(module)
-        traced_fns = self._traced_functions(module.tree, imports)
+        traced_fns = traced_functions(module.tree, imports)
         findings: List[Finding] = []
         for fn, static in traced_fns.items():
             findings.extend(self._check_traced_fn(module, imports, fn, static))
         return findings
-
-    # ------------------------------------------------------------- discovery
-    def _traced_functions(
-        self, tree: ast.Module, imports: ImportMap
-    ) -> Dict[ast.FunctionDef, Set[str]]:
-        by_name: Dict[str, List[ast.FunctionDef]] = {}
-        for node in ast.walk(tree):
-            if isinstance(node, ast.FunctionDef):
-                by_name.setdefault(node.name, []).append(node)
-
-        traced: Dict[ast.FunctionDef, Set[str]] = {}
-
-        def mark(fn: ast.FunctionDef, static: Set[str]) -> None:
-            traced.setdefault(fn, set()).update(static)
-
-        for node in ast.walk(tree):
-            if isinstance(node, ast.FunctionDef):
-                for dec in node.decorator_list:
-                    if _is_jit_expr(dec, imports):
-                        mark(node, _static_params(dec, node))
-            if isinstance(node, ast.Call) and _is_jit_expr(node.func, imports):
-                for arg in node.args:
-                    for inner in ast.walk(arg):
-                        if isinstance(inner, ast.Name) and inner.id in by_name:
-                            for fn in by_name[inner.id]:
-                                mark(fn, _static_params(node, fn))
-        return traced
 
     # -------------------------------------------------------------- analysis
     def _check_traced_fn(

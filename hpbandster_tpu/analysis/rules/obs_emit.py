@@ -1,0 +1,89 @@
+"""obs-emit-in-jit — event emission inside traced JAX code.
+
+``hpbandster_tpu.obs`` emission (``emit``/``span``/``get_bus().emit``) is
+host work: it reads host clocks, takes host locks, and may write files.
+Inside a ``jit``/``vmap``/``pmap``-ed body it either runs once at TRACE
+time (the event fires at compile, silently never again — telemetry that
+lies) or, under callback-style escapes, forces a host round-trip per
+device step. The supported pattern is emitting AROUND the jit boundary:
+the caller opens a span, the traced function stays pure (exactly how
+``parallel/batched_worker.py`` wraps ``backend.evaluate``).
+
+Detection reuses jit-host-sync's traced-function discovery (decorated
+with, or passed into, a jit/vmap/pmap wrapper in this module). Inside a
+traced body it flags:
+
+* calls resolving through the import map into ``hpbandster_tpu.obs``
+  (``emit(...)``, ``span(...)``, ``obs.emit(...)``, aliased imports);
+* ``.emit(...)`` method calls — including on the result of
+  ``get_bus()`` — but only in modules that import ``hpbandster_tpu.obs``
+  at all, so unrelated ``.emit`` APIs elsewhere stay unflagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from hpbandster_tpu.analysis.core import Finding, Rule, SourceModule, register
+from hpbandster_tpu.analysis.rules._util import ImportMap, import_map_for
+from hpbandster_tpu.analysis.rules.jit_purity import traced_functions
+
+_OBS_PREFIX = "hpbandster_tpu.obs"
+
+
+def _module_imports_obs(imports: ImportMap) -> bool:
+    return any(v.startswith(_OBS_PREFIX) or v == "hpbandster_tpu"
+               for v in imports.aliases.values())
+
+
+def _resolves_to_obs(node: ast.expr, imports: ImportMap) -> bool:
+    resolved = imports.resolve(node) or ""
+    # `from hpbandster_tpu import obs` resolves `obs.emit` to
+    # "hpbandster_tpu.obs.emit"; `from hpbandster_tpu.obs import emit`
+    # resolves `emit` to "hpbandster_tpu.obs.emit"
+    return resolved.startswith(_OBS_PREFIX)
+
+
+@register
+class ObsEmitInJitRule(Rule):
+    name = "obs-emit-in-jit"
+    description = (
+        "obs event emission (emit/span/bus.emit) inside a jit/vmap/pmap-ed "
+        "body — fires at trace time, not per execution; emit around the "
+        "jit boundary instead"
+    )
+
+    def check(self, module: SourceModule) -> List[Finding]:
+        # sound prefilter: both a trace wrapper and an obs mention required
+        if "obs" not in module.text or not any(
+            t in module.text for t in ("jit", "pmap", "vmap", "vectorize")
+        ):
+            return []
+        imports = import_map_for(module)
+        imports_obs = _module_imports_obs(imports)
+        findings: List[Finding] = []
+        for fn in traced_functions(module.tree, imports):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _resolves_to_obs(node.func, imports):
+                    what = ast.unparse(node.func)
+                    findings.append(self._flag(module, node, fn, what))
+                elif (
+                    imports_obs
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "emit"
+                ):
+                    findings.append(self._flag(module, node, fn, ".emit()"))
+        return findings
+
+    def _flag(
+        self, module: SourceModule, node: ast.Call, fn: ast.FunctionDef, what: str
+    ) -> Finding:
+        return self.finding(
+            module, node,
+            f"{what} inside traced function {fn.name!r} runs at trace time "
+            "(once per compile), not per execution — move the emission "
+            "outside the jit boundary",
+        )

@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from hpbandster_tpu import obs
 from hpbandster_tpu.core.job import ConfigId, Job
 
 __all__ = ["Status", "Datum", "BaseIteration"]
@@ -267,6 +268,12 @@ class BaseIteration:
                     Status.CRASHED if d.results.get(budget) is None
                     else Status.TERMINATED
                 )
+        obs.emit(
+            obs.BRACKET_PROMOTION,
+            iteration=self.HPB_iter, stage=self.stage,
+            promoted=int(np.sum(advance)), candidates=len(config_ids),
+            budget=budget, next_budget=next_budget,
+        )
         self.logger.debug(
             "iteration %d advanced to stage %d (%d promoted)",
             self.HPB_iter, self.stage, int(np.sum(advance)),

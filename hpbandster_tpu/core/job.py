@@ -4,6 +4,11 @@ Mirrors the reference's ``Job`` record (SURVEY.md §2 "Dispatcher" row):
 config id + kwargs, submitted/started/finished wall-clock timestamps, and a
 result-or-exception outcome. The timestamp schema is preserved verbatim so
 ``Result`` analysis and the JSONL log format stay compatible.
+
+Beside the verbatim wall-clock schema, ``time_it`` also records a
+monotonic-clock twin (``Job.mono``) for the obs layer: durations derived
+via :meth:`mono_duration` are immune to wall-clock jumps, while
+``Job.timestamps`` stays byte-identical to what the reference logs.
 """
 
 from __future__ import annotations
@@ -21,6 +26,8 @@ class Job:
         self.id: ConfigId = tuple(id)  # type: ignore[assignment]
         self.kwargs: Dict[str, Any] = kwargs
         self.timestamps: Dict[str, float] = {}
+        #: monotonic twins of ``timestamps`` (obs spans; never serialized)
+        self.mono: Dict[str, float] = {}
         self.result: Optional[Dict[str, Any]] = None
         self.exception: Optional[str] = None
         self.worker_name: Optional[str] = None
@@ -28,7 +35,16 @@ class Job:
     def time_it(self, which_time: str) -> "Job":
         """Record a wall-clock timestamp ('submitted' | 'started' | 'finished')."""
         self.timestamps[which_time] = time.time()
+        self.mono[which_time] = time.monotonic()
         return self
+
+    def mono_duration(self, start: str, end: str) -> Optional[float]:
+        """Monotonic seconds between two recorded stamps, or None if either
+        is missing (e.g. a requeued job re-records 'started')."""
+        try:
+            return self.mono[end] - self.mono[start]
+        except KeyError:
+            return None
 
     @property
     def loss(self) -> float:

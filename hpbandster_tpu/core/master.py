@@ -22,6 +22,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from hpbandster_tpu import obs
 from hpbandster_tpu.core.iteration import BaseIteration
 from hpbandster_tpu.core.job import ConfigId, Job
 from hpbandster_tpu.core.result import Result
@@ -146,6 +147,17 @@ class Master:
         always passes True — its trickle semantics are pinned by
         ``tests/test_trickle.py``.
         """
+        # result ingestion is the one point every execution tier funnels
+        # through, so job_finished/job_failed (with monotonic queue/run
+        # durations) are emitted here — before the lock: sinks do I/O
+        obs.emit(
+            obs.JOB_FAILED if job.exception is not None else obs.JOB_FINISHED,
+            config_id=list(job.id),
+            budget=job.kwargs.get("budget"),
+            worker=job.worker_name,
+            queue_s=job.mono_duration("submitted", "started"),
+            run_s=job.mono_duration("started", "finished"),
+        )
         with self.thread_cond:
             self.num_running_jobs -= 1
             if self.result_logger is not None:
@@ -177,6 +189,7 @@ class Master:
             "stage": it.stage,
         }
         job.time_it("submitted")
+        obs.emit(obs.JOB_SUBMITTED, config_id=list(config_id), budget=budget)
         with self.thread_cond:
             self.num_running_jobs += 1
             self.jobs.append(job)
@@ -279,8 +292,13 @@ class Master:
         """Snapshot full optimizer state (brackets + model) to ``path``."""
         from hpbandster_tpu.core.checkpoint import save_checkpoint
 
+        t0 = time.monotonic()
         save_checkpoint(self, path)
         self._last_checkpoint = time.time()
+        obs.emit(
+            obs.CHECKPOINT_WRITTEN,
+            path=path, duration_s=round(time.monotonic() - t0, 6),
+        )
         self.logger.debug("checkpoint written to %s", path)
 
     def load_checkpoint(self, path: str) -> None:

@@ -23,10 +23,13 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Tuple
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hpbandster_tpu import obs
 from hpbandster_tpu.core.job import Job
 from hpbandster_tpu.models.base import base_config_generator
 from hpbandster_tpu.ops.kde import (
@@ -190,6 +193,7 @@ class BOHBKDE(base_config_generator):
         n_bad = max(self.min_points_in_model, ((100 - self.top_n_percent) * n) // 100)
         idx = np.argsort(train_losses, kind="stable")
 
+        t0 = time.monotonic()
         good = self.impute_conditional_data(train_configs[idx[:n_good]])
         bad = self.impute_conditional_data(train_configs[idx[-n_bad:]])
         if good.shape[0] <= good.shape[1] or bad.shape[0] <= bad.shape[1]:
@@ -200,6 +204,12 @@ class BOHBKDE(base_config_generator):
             self._make_kde(bad),
         )
         self._device_kdes.pop(budget, None)
+        obs.emit(
+            obs.KDE_REFIT,
+            budget=budget, n_obs=n, n_good=n_good, n_bad=n_bad,
+            duration_s=round(time.monotonic() - t0, 6),
+        )
+        obs.get_metrics().counter("kde.refits").inc()
 
     def _make_kde(self, data: np.ndarray) -> KDE:
         """Fit happens host-side in numpy (no device dispatch per fit); the

@@ -1,0 +1,145 @@
+"""``hpbandster_tpu.obs`` — structured events, metrics, and run journal.
+
+The telemetry substrate the master/dispatcher/worker/optimizer layers
+emit into (see docs/observability.md):
+
+* :mod:`~hpbandster_tpu.obs.metrics` — thread-safe counters / gauges /
+  fixed-bucket histograms with an atomic :meth:`MetricsRegistry.snapshot`;
+* :mod:`~hpbandster_tpu.obs.events` — the typed event bus
+  (``job_submitted`` ... ``unknown_result``) plus monotonic-clock
+  :func:`span` regions, with ``utils/profiling.py`` as the optional
+  ``jax.profiler`` span backend;
+* :mod:`~hpbandster_tpu.obs.journal` — rotating JSONL run journal +
+  in-memory ring buffer for post-mortems;
+* ``python -m hpbandster_tpu.obs summarize <journal>`` — per-stage
+  latency percentiles, worker utilization, failure tallies.
+
+Everything here is stdlib-only and costs ~nothing when no sink is
+attached (the bench's ``obs_overhead`` tier measures exactly that), so
+the instrumentation stays on permanently — attach sinks to look.
+
+Quick start::
+
+    from hpbandster_tpu import obs
+
+    handle = obs.configure(journal_path="run/journal.jsonl")
+    try:
+        ...  # any optimizer run; events stream into the journal
+    finally:
+        handle.close()
+    # then: python -m hpbandster_tpu.obs summarize run/journal.jsonl
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from hpbandster_tpu.obs import events as _events
+from hpbandster_tpu.obs import metrics as _metrics
+from hpbandster_tpu.obs.events import (  # noqa: F401
+    BRACKET_PROMOTION,
+    CHECKPOINT_WRITTEN,
+    EVENT_TYPES,
+    JOB_FAILED,
+    JOB_FINISHED,
+    JOB_STARTED,
+    JOB_SUBMITTED,
+    KDE_REFIT,
+    RPC_RETRY,
+    UNKNOWN_RESULT,
+    WORKER_DISCOVERED,
+    WORKER_DROPPED,
+    Event,
+    EventBus,
+    emit,
+    get_bus,
+    span,
+    use_jax_annotations,
+)
+from hpbandster_tpu.obs.journal import (  # noqa: F401
+    JsonlJournal,
+    RingBuffer,
+    read_journal,
+)
+from hpbandster_tpu.obs.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_metrics,
+)
+
+__all__ = [
+    "Event", "EventBus", "emit", "get_bus", "span", "use_jax_annotations",
+    "JsonlJournal", "RingBuffer", "read_journal",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_metrics",
+    "configure", "set_enabled", "enabled",
+    "EVENT_TYPES", "JOB_SUBMITTED", "JOB_STARTED", "JOB_FINISHED",
+    "JOB_FAILED", "WORKER_DISCOVERED", "WORKER_DROPPED",
+    "BRACKET_PROMOTION", "KDE_REFIT", "RPC_RETRY", "CHECKPOINT_WRITTEN",
+    "UNKNOWN_RESULT",
+]
+
+
+def set_enabled(flag: bool) -> None:
+    """Process-wide kill switch: ``False`` turns every emit / counter /
+    span into a single-boolean-check no-op (the bench's A/B lever)."""
+    _events._set_enabled(flag)
+    _metrics._set_enabled(flag)
+
+
+def enabled() -> bool:
+    return _events._ENABLED
+
+
+class ObsHandle:
+    """What :func:`configure` returns: the attached sinks + one close()."""
+
+    def __init__(self, detachers: List[Callable[[], None]],
+                 journal: Optional[JsonlJournal], ring: Optional[RingBuffer]):
+        self._detachers = detachers
+        self.journal = journal
+        self.ring = ring
+
+    def close(self) -> None:
+        """Detach every sink and close the journal file (idempotent)."""
+        for detach in self._detachers:
+            detach()
+        self._detachers = []
+        if self.journal is not None:
+            self.journal.close()
+
+    def __enter__(self) -> "ObsHandle":
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.close()
+
+
+def configure(
+    journal_path: Optional[str] = None,
+    journal_max_bytes: int = 16 * 1024 * 1024,
+    journal_max_files: int = 3,
+    ring_capacity: int = 0,
+    bus: Optional[EventBus] = None,
+) -> ObsHandle:
+    """Attach the standard sinks to ``bus`` (default: the process bus).
+
+    ``journal_path`` enables the rotating JSONL journal; ``ring_capacity
+    > 0`` additionally keeps the newest events in memory for post-mortems.
+    Returns an :class:`ObsHandle` — close it to detach (tests and
+    multi-run processes must, or sinks accumulate)."""
+    bus = bus if bus is not None else get_bus()
+    detachers: List[Callable[[], None]] = []
+    journal = None
+    ring = None
+    if journal_path is not None:
+        journal = JsonlJournal(
+            journal_path, max_bytes=journal_max_bytes,
+            max_files=journal_max_files,
+        )
+        detachers.append(bus.subscribe(journal))
+    if ring_capacity > 0:
+        ring = RingBuffer(ring_capacity)
+        detachers.append(bus.subscribe(ring))
+    return ObsHandle(detachers, journal, ring)

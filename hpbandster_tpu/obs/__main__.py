@@ -1,0 +1,47 @@
+"""CLI: ``python -m hpbandster_tpu.obs summarize <journal> [--json]``.
+
+Exit codes: 0 success, 2 usage error / unreadable journal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from hpbandster_tpu.obs.journal import journal_paths, read_journal
+from hpbandster_tpu.obs.summarize import format_summary, summarize_records
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m hpbandster_tpu.obs",
+        description="observability tooling (see docs/observability.md)",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    p_sum = sub.add_parser(
+        "summarize",
+        help="per-stage latency percentiles, worker utilization, failures",
+    )
+    p_sum.add_argument("journal", help="path to a JSONL run journal")
+    p_sum.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the summary as JSON instead of text",
+    )
+    args = parser.parse_args(argv)
+
+    if not os.path.exists(args.journal) and not journal_paths(args.journal):
+        print(f"error: journal {args.journal!r} does not exist", file=sys.stderr)
+        return 2
+    summary = summarize_records(read_journal(args.journal))
+    if args.as_json:
+        print(json.dumps(summary, indent=1))
+    else:
+        print(format_summary(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

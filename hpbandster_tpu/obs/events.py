@@ -1,0 +1,201 @@
+"""Structured event bus + monotonic spans — the tracing substrate.
+
+Design constraints (the acceptance bar in docs/observability.md):
+
+* **no-sink cost ~ zero**: ``emit()`` with no subscriber is one tuple
+  read + one boolean check and returns before an :class:`Event` is even
+  constructed — the instrumented hot paths (master submit loop, batched
+  waves, RPC retries) pay nothing when nobody is listening;
+* **thread-safe without emit-side locking**: the sink list is a
+  copy-on-write tuple, so emitters read it with one atomic load while
+  subscribe/unsubscribe swap whole tuples under the bus lock;
+* **monotonic durations**: spans measure with ``time.monotonic()`` —
+  immune to wall-clock jumps — and carry ``time.time()`` alongside only
+  for human-readable journal ordering (the same wall/mono split
+  ``core.job.Job`` records).
+
+The span backend folds in ``utils/profiling.py``: after
+:func:`use_jax_annotations` every span additionally opens a
+``jax.profiler.TraceAnnotation`` so the named region shows up in
+TensorBoard/Perfetto device traces. Never emit events from INSIDE jitted
+code — that is host work in a traced body; the ``obs-emit-in-jit``
+graftlint rule gates the repo on it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+__all__ = [
+    "Event",
+    "EventBus",
+    "get_bus",
+    "emit",
+    "span",
+    "use_jax_annotations",
+    "EVENT_TYPES",
+    "JOB_SUBMITTED",
+    "JOB_STARTED",
+    "JOB_FINISHED",
+    "JOB_FAILED",
+    "WORKER_DISCOVERED",
+    "WORKER_DROPPED",
+    "BRACKET_PROMOTION",
+    "KDE_REFIT",
+    "RPC_RETRY",
+    "CHECKPOINT_WRITTEN",
+    "UNKNOWN_RESULT",
+]
+
+logger = logging.getLogger("hpbandster_tpu.obs")
+
+# ------------------------------------------------------------- typed events
+JOB_SUBMITTED = "job_submitted"
+JOB_STARTED = "job_started"
+JOB_FINISHED = "job_finished"
+JOB_FAILED = "job_failed"
+WORKER_DISCOVERED = "worker_discovered"
+WORKER_DROPPED = "worker_dropped"
+BRACKET_PROMOTION = "bracket_promotion"
+KDE_REFIT = "kde_refit"
+RPC_RETRY = "rpc_retry"
+CHECKPOINT_WRITTEN = "checkpoint_written"
+UNKNOWN_RESULT = "unknown_result"
+
+#: the core vocabulary (docs/observability.md "Event schema"). emit() also
+#: accepts names outside this set — subsystems may add their own (span
+#: names, ``bracket_created``, ``sweep_chunk``) without a registry edit.
+EVENT_TYPES = frozenset({
+    JOB_SUBMITTED, JOB_STARTED, JOB_FINISHED, JOB_FAILED,
+    WORKER_DISCOVERED, WORKER_DROPPED, BRACKET_PROMOTION, KDE_REFIT,
+    RPC_RETRY, CHECKPOINT_WRITTEN, UNKNOWN_RESULT,
+})
+
+#: process-wide kill switch (hpbandster_tpu.obs.set_enabled)
+_ENABLED = True
+
+
+def _set_enabled(flag: bool) -> None:
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """One structured record: name + wall/monotonic stamps + fields."""
+
+    name: str
+    t_wall: float
+    t_mono: float
+    fields: Dict[str, Any]
+
+
+Sink = Callable[[Event], None]
+
+
+class EventBus:
+    """Fan one emit out to every subscribed sink; sinks must not raise
+    (if one does anyway, the error is logged and the other sinks still
+    receive the event — telemetry must never kill the run)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # copy-on-write: emit() reads the tuple with one atomic load; the
+        # lock only serializes subscribe/unsubscribe swaps
+        self._sinks: Tuple[Sink, ...] = ()
+
+    @property
+    def active(self) -> bool:
+        """True when an emit would actually reach a sink."""
+        return _ENABLED and bool(self._sinks)  # graftlint: disable=lock-coverage — copy-on-write tuple: an unlocked read sees a complete old/new tuple
+
+    def subscribe(self, sink: Sink) -> Callable[[], None]:
+        """Attach ``sink``; returns a detach callable (idempotent)."""
+        with self._lock:
+            self._sinks = self._sinks + (sink,)
+
+        def detach() -> None:
+            with self._lock:
+                self._sinks = tuple(s for s in self._sinks if s is not sink)
+
+        return detach
+
+    def emit(self, name: str, **fields: Any) -> Optional[Event]:
+        """Deliver one event; returns it, or None when nobody listens."""
+        sinks = self._sinks  # graftlint: disable=lock-coverage — copy-on-write tuple: an unlocked read sees a complete old/new tuple
+        if not sinks or not _ENABLED:
+            return None
+        ev = Event(name, time.time(), time.monotonic(), fields)
+        for sink in sinks:
+            try:
+                sink(ev)
+            except Exception:
+                logger.exception("obs sink %r failed on %s", sink, name)
+        return ev
+
+
+_DEFAULT_BUS = EventBus()
+
+
+def get_bus() -> EventBus:
+    """The process-wide default bus."""
+    return _DEFAULT_BUS
+
+
+def emit(name: str, **fields: Any) -> Optional[Event]:
+    """``get_bus().emit(...)`` — the module-level convenience every
+    instrumented call site uses."""
+    return _DEFAULT_BUS.emit(name, **fields)
+
+
+# ---------------------------------------------------------------- spans
+#: optional jax.profiler annotation factory (utils.profiling.annotate),
+#: installed by use_jax_annotations(); None = spans are host-only
+_ANNOTATE: Optional[Callable[[str], Any]] = None
+
+
+def use_jax_annotations(enable: bool = True) -> None:
+    """Fold ``utils/profiling.py`` in as the span backend: every span
+    additionally opens a ``jax.profiler.TraceAnnotation`` so named host
+    regions line up with device traces. Off by default (importing jax
+    from the obs layer must stay opt-in)."""
+    global _ANNOTATE
+    if enable:
+        from hpbandster_tpu.utils.profiling import annotate
+
+        _ANNOTATE = annotate
+    else:
+        _ANNOTATE = None
+
+
+@contextlib.contextmanager
+def span(name: str, bus: Optional[EventBus] = None, **fields: Any) -> Iterator[None]:
+    """Monotonic-clock duration region: on exit, emits ``name`` with a
+    ``duration_s`` field (plus ``error=<type>`` if the body raised).
+
+    Near-zero when inactive: with no sinks and no jax annotation backend
+    the body runs with no clock reads at all."""
+    target = bus if bus is not None else _DEFAULT_BUS
+    annotate = _ANNOTATE
+    if not target.active and annotate is None:
+        yield
+        return
+    ctx = annotate(name) if annotate is not None else contextlib.nullcontext()
+    t0 = time.monotonic()
+    error: Optional[str] = None
+    try:
+        with ctx:
+            yield
+    except BaseException as e:
+        error = type(e).__name__
+        raise
+    finally:
+        duration = time.monotonic() - t0
+        if error is not None:
+            fields = dict(fields, error=error)
+        target.emit(name, duration_s=round(duration, 6), **fields)

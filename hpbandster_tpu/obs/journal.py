@@ -1,0 +1,171 @@
+"""Run-journal sinks: rotating JSONL files + an in-memory ring buffer.
+
+:class:`JsonlJournal` is the durable sink — one JSON object per line,
+size-based rotation (``journal.jsonl`` -> ``journal.jsonl.1`` -> ``.2``
+...), so a long sweep's telemetry is bounded on disk and the newest
+events are always in the live file. :class:`RingBuffer` is the
+post-mortem sink — the last N events stay in memory even when no journal
+is configured, which is what the dispatcher's dead-letter path and crash
+analysis read.
+
+Rotation semantics (pinned by ``tests/test_obs.py``): a write that would
+push the live file PAST ``max_bytes`` rotates first, so every rotated
+file is <= ``max_bytes`` — unless a single line alone exceeds it, which
+is written whole to a fresh file (a journal must never split a line).
+No line is ever dropped by rotation itself; only files older than
+``max_files`` rotations are deleted.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from hpbandster_tpu.obs.events import Event
+
+__all__ = ["JsonlJournal", "RingBuffer", "journal_paths", "read_journal"]
+
+
+def _jsonable(x: Any) -> Any:
+    """Best-effort coercion for event fields (numpy scalars, tuples...)."""
+    try:
+        return float(x)
+    except (TypeError, ValueError):
+        return str(x)
+
+
+def event_to_record(ev: Event) -> Dict[str, Any]:
+    """The on-disk schema: event name + stamps flattened with the fields
+    (field names never collide — ``event``/``t_wall``/``t_mono`` are
+    reserved, docs/observability.md)."""
+    rec = {"event": ev.name, "t_wall": ev.t_wall, "t_mono": ev.t_mono}
+    rec.update(ev.fields)
+    return rec
+
+
+class RingBuffer:
+    """Keep the newest ``capacity`` items; usable directly as a bus sink."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._items: collections.deque = collections.deque(maxlen=self.capacity)
+
+    def __call__(self, event: Event) -> None:
+        self.append(event)
+
+    def append(self, item: Any) -> None:
+        with self._lock:
+            self._items.append(item)
+
+    def snapshot(self) -> List[Any]:
+        """Oldest-first copy of the current contents."""
+        with self._lock:
+            return list(self._items)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+
+class JsonlJournal:
+    """Rotating JSONL event sink; subscribe it to a bus, or call directly."""
+
+    def __init__(
+        self,
+        path: str,
+        max_bytes: int = 16 * 1024 * 1024,
+        max_files: int = 3,
+    ):
+        if max_bytes <= 0:
+            raise ValueError("max_bytes must be positive")
+        self.path = path
+        self.max_bytes = int(max_bytes)
+        self.max_files = max(int(max_files), 1)
+        self._lock = threading.Lock()
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        self._fh = open(path, "a", encoding="utf-8")
+        self._size = os.path.getsize(path)
+        self.rotations = 0
+
+    # --------------------------------------------------------------- writing
+    def __call__(self, event: Event) -> None:
+        self.write_record(event_to_record(event))
+
+    def write_record(self, record: Dict[str, Any]) -> None:
+        line = json.dumps(record, default=_jsonable) + "\n"
+        data = line.encode("utf-8")
+        with self._lock:
+            if self._fh is None:
+                return  # closed: late emits from draining threads are dropped
+            if self._size > 0 and self._size + len(data) > self.max_bytes:
+                self._rotate_locked()
+            self._fh.write(line)
+            self._fh.flush()
+            self._size += len(data)
+
+    def _rotate_locked(self) -> None:
+        # sole caller is write_record, inside `with self._lock:`
+        self._fh.close()  # graftlint: disable=lock-coverage — caller holds self._lock
+        oldest = f"{self.path}.{self.max_files}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for k in range(self.max_files - 1, 0, -1):
+            src = f"{self.path}.{k}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{k + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        self._fh = open(self.path, "a", encoding="utf-8")  # graftlint: disable=lock-coverage — caller holds self._lock
+        self._size = 0  # graftlint: disable=lock-coverage — caller holds self._lock
+        self.rotations += 1
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def __enter__(self) -> "JsonlJournal":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+# ---------------------------------------------------------------- reading
+def journal_paths(path: str) -> List[str]:
+    """Every on-disk file of one journal, oldest first: ``path.N`` down to
+    ``path.1``, then the live ``path``."""
+    rotated = []
+    k = 1
+    while os.path.exists(f"{path}.{k}"):
+        rotated.append(f"{path}.{k}")
+        k += 1
+    out = list(reversed(rotated))
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
+def read_journal(path: str) -> List[Dict[str, Any]]:
+    """All records of a (possibly rotated) journal, oldest first.
+
+    Unparseable lines (a crash mid-write on the final line) are skipped,
+    not fatal — a post-mortem reader must survive the crash it documents.
+    """
+    records: List[Dict[str, Any]] = []
+    for fn in journal_paths(path):
+        with open(fn, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except ValueError:
+                    continue
+    return records

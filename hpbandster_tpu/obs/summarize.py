@@ -1,0 +1,175 @@
+"""Journal post-processing: ``python -m hpbandster_tpu.obs summarize``.
+
+Reads a (possibly rotated) JSONL run journal and prints the run's shape:
+
+* **per-stage latencies** — p50/p95 over the ``queue_s`` (submitted ->
+  started) and ``run_s`` (started -> finished) durations carried by
+  ``job_finished``/``job_failed`` events, plus every span event's
+  ``duration_s`` grouped by name (``kde_refit``, ``wave_evaluate``,
+  ``sweep_chunk``, ...);
+* **worker utilization** — per worker, busy seconds (sum of ``run_s``)
+  over the journal's wall-clock window, with jobs/failures tallied;
+* **failure tallies** — failed jobs, RPC retries, dropped workers,
+  dead-lettered unknown results.
+
+Durations are computed at the EMITTING site from monotonic clocks and
+carried in the events, so the summary never subtracts wall-clock stamps
+(immune to clock jumps) and never has to join event streams across
+processes.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List, Optional
+
+from hpbandster_tpu.obs import events as E
+from hpbandster_tpu.obs.journal import read_journal
+
+__all__ = ["summarize_records", "format_summary", "summarize_path"]
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        raise ValueError("no values")
+    k = max(int(round(q * (len(sorted_vals) - 1))), 0)
+    return sorted_vals[min(k, len(sorted_vals) - 1)]
+
+
+def _stats(vals: Iterable[float]) -> Optional[Dict[str, Any]]:
+    vals = sorted(float(v) for v in vals)
+    if not vals:
+        return None
+    return {
+        "count": len(vals),
+        "p50": round(_percentile(vals, 0.50), 6),
+        "p95": round(_percentile(vals, 0.95), 6),
+        "max": round(vals[-1], 6),
+        "total": round(sum(vals), 6),
+    }
+
+
+def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate journal records into the summary dict the CLI renders."""
+    counts: Dict[str, int] = {}
+    queue_s: List[float] = []
+    run_s: List[float] = []
+    spans: Dict[str, List[float]] = {}
+    workers: Dict[str, Dict[str, float]] = {}
+    t_wall_min: Optional[float] = None
+    t_wall_max: Optional[float] = None
+
+    def worker_slot(name: str) -> Dict[str, float]:
+        return workers.setdefault(
+            name, {"busy_s": 0.0, "jobs": 0, "failed": 0}
+        )
+
+    for rec in records:
+        name = rec.get("event")
+        if not name:
+            continue
+        counts[name] = counts.get(name, 0) + 1
+        tw = rec.get("t_wall")
+        if isinstance(tw, (int, float)):
+            t_wall_min = tw if t_wall_min is None else min(t_wall_min, tw)
+            t_wall_max = tw if t_wall_max is None else max(t_wall_max, tw)
+
+        if name in (E.JOB_FINISHED, E.JOB_FAILED):
+            q, r = rec.get("queue_s"), rec.get("run_s")
+            if isinstance(q, (int, float)):
+                queue_s.append(q)
+            if isinstance(r, (int, float)):
+                run_s.append(r)
+            w = rec.get("worker")
+            if w:
+                slot = worker_slot(str(w))
+                slot["jobs"] += 1
+                if isinstance(r, (int, float)):
+                    slot["busy_s"] += r
+                if name == E.JOB_FAILED:
+                    slot["failed"] += 1
+        elif isinstance(rec.get("duration_s"), (int, float)):
+            spans.setdefault(name, []).append(rec["duration_s"])
+
+    window_s = (
+        (t_wall_max - t_wall_min)
+        if t_wall_min is not None and t_wall_max is not None
+        else 0.0
+    )
+    utilization = {}
+    for wname, slot in sorted(workers.items()):
+        utilization[wname] = {
+            "jobs": int(slot["jobs"]),
+            "failed": int(slot["failed"]),
+            "busy_s": round(slot["busy_s"], 3),
+            "utilization": (
+                round(min(slot["busy_s"] / window_s, 1.0), 4)
+                if window_s > 0 else None
+            ),
+        }
+
+    stages: Dict[str, Any] = {}
+    if queue_s:
+        stages["queue"] = _stats(queue_s)
+    if run_s:
+        stages["run"] = _stats(run_s)
+    for sname in sorted(spans):
+        stages[sname] = _stats(spans[sname])
+
+    return {
+        "events_total": sum(counts.values()),
+        "window_s": round(window_s, 3),
+        "event_counts": dict(sorted(counts.items())),
+        "stage_latency_s": stages,
+        "worker_utilization": utilization,
+        "failures": {
+            "jobs_failed": counts.get(E.JOB_FAILED, 0),
+            "rpc_retries": counts.get(E.RPC_RETRY, 0),
+            "workers_dropped": counts.get(E.WORKER_DROPPED, 0),
+            "unknown_results_dead_lettered": counts.get(E.UNKNOWN_RESULT, 0),
+        },
+    }
+
+
+def summarize_path(path: str) -> Dict[str, Any]:
+    return summarize_records(read_journal(path))
+
+
+def format_summary(s: Dict[str, Any]) -> str:
+    lines = [
+        f"events: {s['events_total']} over {s['window_s']}s",
+        "",
+        "stage latency (seconds):",
+        f"  {'stage':<24} {'count':>6} {'p50':>10} {'p95':>10} {'max':>10}",
+    ]
+    for name, st in s["stage_latency_s"].items():
+        lines.append(
+            f"  {name:<24} {st['count']:>6} {st['p50']:>10.4f} "
+            f"{st['p95']:>10.4f} {st['max']:>10.4f}"
+        )
+    if not s["stage_latency_s"]:
+        lines.append("  (no duration-carrying events in this journal)")
+    lines.append("")
+    lines.append("worker utilization:")
+    for wname, u in s["worker_utilization"].items():
+        util = "n/a" if u["utilization"] is None else f"{100 * u['utilization']:.1f}%"
+        lines.append(
+            f"  {wname}: {u['jobs']} jobs ({u['failed']} failed), "
+            f"busy {u['busy_s']}s, utilization {util}"
+        )
+    if not s["worker_utilization"]:
+        lines.append("  (no worker-attributed jobs in this journal)")
+    lines.append("")
+    f = s["failures"]
+    lines.append(
+        "failures: %d jobs failed, %d rpc retries, %d workers dropped, "
+        "%d unknown results dead-lettered"
+        % (
+            f["jobs_failed"], f["rpc_retries"],
+            f["workers_dropped"], f["unknown_results_dead_lettered"],
+        )
+    )
+    lines.append("")
+    lines.append("event counts: " + json.dumps(s["event_counts"]))
+    return "\n".join(lines)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
+from hpbandster_tpu import obs
 from hpbandster_tpu.core.master import Master
 from hpbandster_tpu.core.successive_halving import SuccessiveHalving
 from hpbandster_tpu.models.bohb_kde import BOHBKDE
@@ -78,6 +79,12 @@ class BOHB(Master):
         self, iteration: int, iteration_kwargs: Dict[str, Any]
     ) -> SuccessiveHalving:
         plan = hyperband_bracket(iteration, self.min_budget, self.max_budget, self.eta)
+        obs.emit(
+            "bracket_created",
+            iteration=iteration,
+            num_configs=list(plan.num_configs),
+            budgets=list(plan.budgets),
+        )
         return self.iteration_class(
             HPB_iter=iteration,
             num_configs=list(plan.num_configs),

@@ -28,6 +28,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from hpbandster_tpu import obs
 from hpbandster_tpu.core.job import Job
 from hpbandster_tpu.space import ConfigurationSpace
 
@@ -235,7 +236,8 @@ class BatchedExecutor:
             for j in group:
                 j.time_it("started")
             try:
-                losses = self.backend.evaluate(vectors, budget)
+                with obs.span("stage_batch", n=len(group), budget=budget):
+                    losses = self.backend.evaluate(vectors, budget)
             except Exception as e:  # backend-level failure crashes the wave
                 self.logger.exception("batched evaluation failed at budget %g", budget)
                 losses = np.full(len(group), np.nan)

@@ -38,6 +38,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from hpbandster_tpu import obs
 from hpbandster_tpu.core.worker import Worker
 from hpbandster_tpu.parallel.rpc import (
     CommunicationError,
@@ -117,7 +118,8 @@ class TPUBatchedWorker(Worker):
         with self._busy_lock:
             self._last_active = time.time()
             t0 = time.perf_counter()
-            losses = self.backend.evaluate(arr, float(budget))
+            with obs.span("worker_evaluate_batch", n=len(arr), budget=float(budget)):
+                losses = self.backend.evaluate(arr, float(budget))
             self.logger.debug(
                 "evaluate_batch: %d configs at budget %g in %.3fs",
                 len(arr), budget, time.perf_counter() - t0,
@@ -211,7 +213,11 @@ class RPCBatchBackend:
         #: names with an in-flight capability probe (don't re-probe)
         self._probing: set = set()
         #: name -> earliest next-probe time after a transient failure, so an
-        #: unreachable candidate doesn't get re-probed every refresh
+        #: unreachable candidate doesn't get re-probed every refresh.
+        #: MONOTONIC clock throughout the backoff/deadline math here: a
+        #: wall-clock jump (NTP step, suspend/resume) must not expire — or
+        #: indefinitely extend — a backoff window (Job.timestamps stays
+        #: wall-clock verbatim; only internal arithmetic is monotonic)
         self._probe_backoff: Dict[str, float] = {}
         self.probe_backoff_s = 5.0
         self._last_refresh = 0.0
@@ -223,7 +229,7 @@ class RPCBatchBackend:
         return f"hpbandster.run_{self.run_id}.worker."
 
     def refresh_workers(self, force: bool = False) -> None:
-        now = time.time()
+        now = time.monotonic()
         with self._lock:
             if not force and now - self._last_refresh < self.refresh_interval:
                 return
@@ -243,6 +249,7 @@ class RPCBatchBackend:
             gone = set(self._workers) - set(listing)
             for name in gone:
                 self.logger.info("batched worker %s left the pool", name)
+                obs.emit(obs.WORKER_DROPPED, worker=name, reason="unregistered")
                 del self._workers[name]
             to_probe = []
             for name, uri in listing.items():
@@ -278,7 +285,7 @@ class RPCBatchBackend:
                     # every refresh tick
                     with self._lock:
                         self._probe_backoff[name] = (
-                            time.time() + self.probe_backoff_s
+                            time.monotonic() + self.probe_backoff_s
                         )
                     return
                 if not isinstance(caps, dict) or not caps.get("batch"):
@@ -289,6 +296,9 @@ class RPCBatchBackend:
                 with self._lock:
                     self._workers[name] = proxy
                     self._probe_backoff.pop(name, None)
+                obs.emit(
+                    obs.WORKER_DISCOVERED, worker=name, devices=proxy.devices
+                )
                 self.logger.info(
                     "batched worker %s joined (%d devices)", name, proxy.devices
                 )
@@ -307,8 +317,8 @@ class RPCBatchBackend:
             return sum(w.devices for w in self._workers.values()) or 0
 
     def wait_for_workers(self, min_n_workers: int = 1, timeout: float = 60.0) -> None:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             self.refresh_workers(force=True)
             with self._lock:
                 if len(self._workers) >= min_n_workers:
@@ -338,6 +348,10 @@ class RPCBatchBackend:
         return [(w, lo, hi) for w, lo, hi in bounds if hi > lo]
 
     def evaluate(self, vectors: np.ndarray, budget: float) -> np.ndarray:
+        with obs.span("wave_evaluate", n=len(vectors), budget=float(budget)):
+            return self._evaluate(vectors, budget)
+
+    def _evaluate(self, vectors: np.ndarray, budget: float) -> np.ndarray:
         vectors = np.asarray(vectors, dtype=np.float32)
         n = len(vectors)
         losses = np.full(n, np.nan, dtype=np.float32)
@@ -359,8 +373,8 @@ class RPCBatchBackend:
                 # probes are async now — if one is in flight (e.g. a fresh
                 # worker replacing the crashed pool), give it a moment to
                 # land before declaring the wave dead
-                deadline = time.time() + self.probe_backoff_s
-                while time.time() < deadline:
+                deadline = time.monotonic() + self.probe_backoff_s
+                while time.monotonic() < deadline:
                     with self._lock:
                         probing = bool(self._probing)
                         workers = [
@@ -391,6 +405,10 @@ class RPCBatchBackend:
                     self.logger.warning(
                         "shard of %d configs failed on %s: %r", len(idx), w.name, e
                     )
+                    obs.emit(
+                        obs.WORKER_DROPPED,
+                        worker=w.name, reason="shard failed", n_configs=len(idx),
+                    )
                     with failed_lock:
                         failed.append(idx)
                         failed_names.add(w.name)
@@ -409,6 +427,11 @@ class RPCBatchBackend:
             if not failed:
                 return losses
             pending = np.concatenate(failed)
+            obs.emit(
+                obs.RPC_RETRY, attempt=attempt + 1,
+                max_retries=self.max_retries, pending=len(pending),
+            )
+            obs.get_metrics().counter("rpc.batch_shard_retries").inc()
             self.logger.info(
                 "retrying %d failed config(s), attempt %d/%d",
                 len(pending), attempt + 1, self.max_retries,

@@ -17,7 +17,9 @@ import logging
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
+from hpbandster_tpu import obs
 from hpbandster_tpu.core.job import Job
+from hpbandster_tpu.obs.journal import RingBuffer
 from hpbandster_tpu.parallel.rpc import (
     CommunicationError,
     RPCError,
@@ -74,6 +76,11 @@ class Dispatcher:
         self.workers: Dict[str, WorkerProxy] = {}
         self.waiting_jobs: List[Job] = []
         self.running_jobs: Dict[Any, Job] = {}
+
+        #: dead-letter trail for results that arrive for unknown jobs (the
+        #: worker already computed them — the payload must not vanish):
+        #: counted in obs metrics AND retained here for post-mortems
+        self.dead_letters = RingBuffer(capacity=64)
 
         self._cond = threading.Condition()
         self._shutdown_event = threading.Event()
@@ -161,6 +168,8 @@ class Dispatcher:
             with self._cond:
                 self.workers[name] = w
             added += 1
+            obs.emit(obs.WORKER_DISCOVERED, worker=name, uri=uri)
+            obs.get_metrics().counter("dispatcher.workers_discovered").inc()
             self.logger.info("discovered worker %s at %s", name, uri)
         vanished = known - set(listing)
         for name in vanished:
@@ -186,6 +195,12 @@ class Dispatcher:
             else:
                 self.logger.info("worker %s dropped (%s)", name, reason)
             self._cond.notify_all()
+        obs.emit(
+            obs.WORKER_DROPPED,
+            worker=name, reason=reason,
+            requeued=list(job.id) if job is not None else None,
+        )
+        obs.get_metrics().counter("dispatcher.workers_dropped").inc()
 
     def _ping_loop(self) -> None:
         """Detect workers dying mid-job (requeue their jobs)."""
@@ -231,6 +246,9 @@ class Dispatcher:
                     id=list(job.id),
                     **job.kwargs,
                 )
+                obs.emit(
+                    obs.JOB_STARTED, config_id=list(job.id), worker=worker.name
+                )
                 self.logger.debug("job %s -> %s", job.id, worker.name)
             except (CommunicationError, RPCError) as e:
                 self.logger.warning(
@@ -250,13 +268,26 @@ class Dispatcher:
         cid = tuple(id)
         with self._cond:
             job = self.running_jobs.pop(cid, None)
-            if job is None:
-                self.logger.warning("result for unknown job %s ignored", cid)
-                return False
-            for w in self.workers.values():
-                if w.runs_job is not None and tuple(w.runs_job) == cid:
-                    w.runs_job = None
-            self._cond.notify_all()
+            if job is not None:
+                for w in self.workers.values():
+                    if w.runs_job is not None and tuple(w.runs_job) == cid:
+                        w.runs_job = None
+                self._cond.notify_all()
+        if job is None:
+            # dead-letter, don't drop: a worker computed this (e.g. a late
+            # result landing after its worker was declared dead, requeued,
+            # and re-discovered) — count it and retain the payload for
+            # post-mortems instead of losing data silently. Outside the
+            # lock: sinks do I/O, and a journal write must not stall the
+            # job-runner loop on self._cond.
+            self.dead_letters.append({"config_id": list(cid), "result": result})
+            obs.get_metrics().counter("dispatcher.unknown_results").inc()
+            obs.emit(obs.UNKNOWN_RESULT, config_id=list(cid))
+            self.logger.warning(
+                "result for unknown job %s dead-lettered (%d retained)",
+                cid, len(self.dead_letters),
+            )
+            return False
         job.time_it("finished")
         job.result = result.get("result")
         job.exception = result.get("exception")

@@ -19,9 +19,18 @@ import threading
 import traceback
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from hpbandster_tpu.obs import get_metrics
+
 __all__ = ["RPCServer", "RPCProxy", "RPCError", "CommunicationError", "parse_uri", "format_uri"]
 
 logger = logging.getLogger("hpbandster_tpu.rpc")
+
+
+def _count(name: str) -> None:
+    # looked up per call (one dict access under the registry lock, noise
+    # next to a TCP round-trip) rather than cached at import: a cached
+    # instrument would be orphaned by MetricsRegistry.reset()
+    get_metrics().counter(name).inc()
 
 _MAX_FRAME = 64 * 1024 * 1024  # 64 MiB per message
 
@@ -161,16 +170,20 @@ class RPCProxy:
 
     def call(self, method: str, **params: Any) -> Any:
         payload = json.dumps({"method": method, "params": params}).encode("utf-8")
+        _count("rpc.client_calls")
         try:
             with socket.create_connection(self.addr, timeout=self.timeout) as sock:
                 sock.sendall(payload + b"\n")
                 raw = _read_frame(sock)
         except (ConnectionError, OSError) as e:
+            _count("rpc.client_comm_errors")
             raise CommunicationError(f"cannot reach {self.uri}: {e!r}") from e
         if not raw:
+            _count("rpc.client_comm_errors")
             raise CommunicationError(f"{self.uri} closed the connection")
         reply = json.loads(raw.decode("utf-8"))
         if "error" in reply:
+            _count("rpc.client_remote_errors")
             raise RPCError(reply["error"])
         return reply.get("result")
 

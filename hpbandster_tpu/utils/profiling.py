@@ -11,11 +11,15 @@ from __future__ import annotations
 
 import contextlib
 import logging
-from typing import Iterator, Optional
+from typing import Callable, Iterator, Optional
 
 logger = logging.getLogger("hpbandster_tpu.profiling")
 
 __all__ = ["trace", "annotate", "attach_profiler"]
+
+#: marker attribute on wrapped flush callables, holding the unwrapped
+#: original — the idempotence/detach contract of attach_profiler
+_ORIGINAL_ATTR = "_hpb_profiler_original_flush"
 
 
 @contextlib.contextmanager
@@ -37,19 +41,37 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
-def attach_profiler(executor, log_dir: str) -> None:
+def attach_profiler(executor, log_dir: str) -> Callable[[], None]:
     """Wrap a BatchedExecutor's flush so every device wave is captured.
+
+    Idempotent: calling it again (same or different ``log_dir``) replaces
+    the previous wrapper instead of stacking a second trace around the
+    first. Returns a ``detach()`` handle that restores the unwrapped
+    flush — itself idempotent, and a no-op if someone else re-wrapped
+    flush in the meantime (their wrapper is not ours to remove).
 
     Usage::
 
         executor = BatchedExecutor(backend, cs)
-        attach_profiler(executor, "/tmp/hpb_trace")
+        detach = attach_profiler(executor, "/tmp/hpb_trace")
+        ...
+        detach()
     """
-    original_flush = executor.flush
+    # re-attach: unwrap back to the true flush, never wrap a wrapper
+    original_flush = getattr(executor.flush, _ORIGINAL_ATTR, executor.flush)
 
     def profiled_flush():
         with trace(log_dir):
             return original_flush()
 
+    setattr(profiled_flush, _ORIGINAL_ATTR, original_flush)
     executor.flush = profiled_flush
+
+    def detach() -> None:
+        # only remove OUR wrapper: a stale handle after a re-attach (or a
+        # third party re-wrapping flush) must not rip out the newer wrapper
+        if getattr(executor, "flush", None) is profiled_flush:
+            executor.flush = original_flush
+
     logger.info("profiler attached; traces -> %s", log_dir)
+    return detach

@@ -1,0 +1,37 @@
+"""Known-bad: obs event emission inside jitted bodies (obs-emit-in-jit).
+
+Each flagged line is marked ``# BAD``. These emissions run ONCE at trace
+time and never again — the journal would show one event for a million
+device executions.
+"""
+
+import jax
+
+from hpbandster_tpu import obs
+from hpbandster_tpu.obs import emit, span
+
+
+@jax.jit
+def step(x):
+    obs.emit("job_started", n=1)  # BAD
+    return x * 2
+
+
+@jax.jit
+def step_direct(x):
+    emit("kde_refit", budget=1.0)  # BAD
+    return x + 1
+
+
+def loss(v):
+    with span("loss_eval"):  # BAD
+        return v - 1
+
+
+def scorer(v):
+    obs.get_bus().emit("wave_evaluate", n=3)  # BAD
+    return v
+
+
+loss_fn = jax.jit(loss)
+scorer_fn = jax.vmap(scorer)

@@ -1,0 +1,31 @@
+"""Known-good: obs emission around — never inside — the jit boundary."""
+
+import jax
+
+from hpbandster_tpu import obs
+
+
+@jax.jit
+def step(x):
+    # pure traced body: no host telemetry
+    return x * 2
+
+
+def run_wave(xs):
+    # the sanctioned pattern: the HOST wrapper spans the device call
+    with obs.span("wave_evaluate", n=len(xs)):
+        out = step(xs)
+    obs.emit("job_finished", n=len(xs))
+    return out
+
+
+def tallies(bus):
+    # .emit outside any traced function is ordinary host code
+    bus.emit("worker_discovered", worker="w0")
+
+
+@jax.jit
+def probed_step(x):
+    # trace-time probe: fires once per COMPILE by design (counts compiles)
+    obs.get_metrics().counter("compiles").inc()  # graftlint: disable=obs-emit-in-jit — deliberate trace-time compile counter, not per-step telemetry
+    return x + 1

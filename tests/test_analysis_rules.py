@@ -22,6 +22,7 @@ CASES = [
     ("lock-coverage", "locks_bad.py", "locks_good.py"),
     ("swallowed-exception", "exceptions_bad.py", "exceptions_good.py"),
     ("pytest-marker", "test_markers_bad.py", "test_markers_good.py"),
+    ("obs-emit-in-jit", "obs_emit_bad.py", "obs_emit_good.py"),
 ]
 
 

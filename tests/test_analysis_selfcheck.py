@@ -17,6 +17,7 @@ from hpbandster_tpu.analysis.__main__ import main
 REPO = Path(__file__).resolve().parent.parent
 FIXTURES = Path(__file__).parent / "analysis_fixtures"
 SCAN = [str(REPO / "hpbandster_tpu"), str(REPO / "tests")]
+OBS_TREE = REPO / "hpbandster_tpu" / "obs"
 
 RULE_TO_BAD_FIXTURE = {
     "jit-host-sync": "jit_host_sync_bad.py",
@@ -24,6 +25,7 @@ RULE_TO_BAD_FIXTURE = {
     "lock-coverage": "locks_bad.py",
     "swallowed-exception": "exceptions_bad.py",
     "pytest-marker": "test_markers_bad.py",
+    "obs-emit-in-jit": "obs_emit_bad.py",
 }
 
 
@@ -33,6 +35,19 @@ def test_rule_pack_is_registered():
 
 def test_repo_tree_is_clean():
     findings = run(SCAN)
+    assert findings == [], "\n" + format_report(findings)
+
+
+def test_obs_tree_is_scanned_and_clean():
+    """The obs subsystem is inside the gate's scan paths (no new package
+    may silently fall outside the walk) and graftlint-clean on its own."""
+    from hpbandster_tpu.analysis import collect_files
+
+    scanned = set(collect_files(SCAN))
+    obs_files = {str(p) for p in OBS_TREE.glob("*.py")}
+    assert obs_files, "hpbandster_tpu/obs has no python files?"
+    assert obs_files <= scanned, sorted(obs_files - scanned)
+    findings = run([str(OBS_TREE)])
     assert findings == [], "\n" + format_report(findings)
 
 

@@ -287,6 +287,10 @@ def _stub_tiers(monkeypatch, calls):
     monkeypatch.setattr(bench, "bench_chunked_compile",
                         lambda **kw: {"fresh_compiles_static_vs_dynamic":
                                       [3, 1]})
+    monkeypatch.setattr(
+        bench, "bench_obs_overhead",
+        lambda **kw: calls.setdefault("obs_overhead", True)
+        and {"overhead_pct": 0.1})
 
 
 class TestFallbackContract:
@@ -438,7 +442,7 @@ class TestTierSelection:
         assert set(bench.TIER_ORDER) == {
             "cnn", "cnn_wide", "pallas", "resnet", "transformer",
             "fused10k", "chunked10k", "chunked_compile", "fused", "rpc",
-            "batched", "teacher",
+            "batched", "teacher", "obs_overhead",
         }
 
 

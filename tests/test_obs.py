@@ -1,0 +1,361 @@
+"""hpbandster_tpu.obs — metrics, events, journal, dead-letter, CLI.
+
+The contracts pinned here are the ones docs/observability.md promises:
+atomic metric snapshots under thread hammering, journal rotation that
+never loses a line it retains, the dispatcher dead-letter path counting
+(not dropping) late results, and the summarize CLI printing per-stage
+percentiles + worker utilization from a real end-to-end BOHB run.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from hpbandster_tpu import obs
+from hpbandster_tpu.obs.__main__ import main as obs_main
+from hpbandster_tpu.obs.journal import journal_paths
+from hpbandster_tpu.obs.metrics import MetricsRegistry
+
+
+class TestMetricsRegistry:
+    def test_snapshot_equals_sum_under_thread_hammer(self):
+        """N threads hammering counters/histograms; the atomic snapshot
+        must account for every update exactly once."""
+        reg = MetricsRegistry()
+        counter = reg.counter("jobs")
+        hist = reg.histogram("latency", buckets=(0.01, 0.1, 1.0))
+        gauge = reg.gauge("depth")
+        n_threads, n_per = 8, 2000
+
+        def work(tid):
+            for i in range(n_per):
+                counter.inc()
+                hist.observe(0.05)
+                gauge.set(tid)
+
+        threads = [
+            threading.Thread(target=work, args=(t,)) for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        snap = reg.snapshot()
+        assert snap["counters"]["jobs"] == n_threads * n_per
+        h = snap["histograms"]["latency"]
+        assert h["count"] == n_threads * n_per
+        assert h["sum"] == pytest.approx(0.05 * n_threads * n_per)
+        # every observation landed in the 0.1 bucket, none leaked elsewhere
+        assert h["buckets"]["0.1"] == n_threads * n_per
+        assert h["p50"] == 0.1 and h["p95"] == 0.1
+
+    def test_same_name_same_instrument_kind_mismatch_raises(self):
+        reg = MetricsRegistry()
+        assert reg.counter("x") is reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x")
+
+    def test_snapshot_is_consistent_mid_hammer(self):
+        """Two counters incremented in lockstep: any atomic snapshot must
+        see them at most 1 apart (the increments happen one lock apart)."""
+        reg = MetricsRegistry()
+        a, b = reg.counter("a"), reg.counter("b")
+        stop = threading.Event()
+
+        def work():
+            while not stop.is_set():
+                a.inc()
+                b.inc()
+
+        t = threading.Thread(target=work)
+        t.start()
+        try:
+            for _ in range(200):
+                snap = reg.snapshot()["counters"]
+                assert 0 <= snap["a"] - snap["b"] <= 1, snap
+        finally:
+            stop.set()
+            t.join()
+
+    def test_disabled_metrics_drop_updates(self):
+        reg = MetricsRegistry()
+        c = reg.counter("x")
+        c.inc()
+        obs.set_enabled(False)
+        try:
+            c.inc(100)
+        finally:
+            obs.set_enabled(True)
+        assert c.value == 1
+
+
+class TestEventBus:
+    def test_emit_reaches_all_sinks_and_detach_works(self):
+        bus = obs.EventBus()
+        seen_a, seen_b = [], []
+        detach_a = bus.subscribe(seen_a.append)
+        bus.subscribe(seen_b.append)
+        bus.emit("job_submitted", config_id=[0, 0, 1])
+        detach_a()
+        detach_a()  # idempotent
+        bus.emit("job_finished")
+        assert [e.name for e in seen_a] == ["job_submitted"]
+        assert [e.name for e in seen_b] == ["job_submitted", "job_finished"]
+        assert seen_b[0].fields == {"config_id": [0, 0, 1]}
+
+    def test_emit_without_sinks_returns_none(self):
+        assert obs.EventBus().emit("job_started") is None
+
+    def test_failing_sink_does_not_starve_others(self):
+        bus = obs.EventBus()
+        seen = []
+
+        def bad_sink(ev):
+            raise RuntimeError("sink bug")
+
+        bus.subscribe(bad_sink)
+        bus.subscribe(seen.append)
+        bus.emit("worker_discovered", worker="w")
+        assert len(seen) == 1
+
+    def test_span_emits_duration_and_error_type(self):
+        bus = obs.EventBus()
+        seen = []
+        bus.subscribe(seen.append)
+        with obs.span("kde_refit", bus=bus, budget=3.0):
+            pass
+        with pytest.raises(ValueError):
+            with obs.span("kde_refit", bus=bus):
+                raise ValueError("boom")
+        assert len(seen) == 2
+        assert seen[0].fields["duration_s"] >= 0
+        assert seen[0].fields["budget"] == 3.0
+        assert seen[1].fields["error"] == "ValueError"
+
+    def test_disabled_bus_emits_nothing(self):
+        bus = obs.EventBus()
+        seen = []
+        bus.subscribe(seen.append)
+        obs.set_enabled(False)
+        try:
+            assert bus.emit("job_started") is None
+            with obs.span("x", bus=bus):
+                pass
+        finally:
+            obs.set_enabled(True)
+        assert seen == []
+
+
+class TestJournalRotation:
+    def test_rotation_at_size_boundary_loses_no_line(self, tmp_path):
+        """Writes that would cross max_bytes rotate first: every retained
+        file stays under the cap and every line survives, in order."""
+        path = str(tmp_path / "journal.jsonl")
+        max_bytes = 400
+        journal = obs.JsonlJournal(path, max_bytes=max_bytes, max_files=100)
+        n = 120
+        for i in range(n):
+            journal.write_record({"event": "job_finished", "i": i})
+        journal.close()
+
+        assert journal.rotations > 0, "test must actually cross the boundary"
+        for fn in journal_paths(path):
+            assert os.path.getsize(fn) <= max_bytes, fn
+        records = obs.read_journal(path)
+        assert [r["i"] for r in records] == list(range(n))
+
+    def test_single_line_larger_than_cap_is_written_whole(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        journal = obs.JsonlJournal(path, max_bytes=64, max_files=10)
+        journal.write_record({"event": "a"})
+        journal.write_record({"event": "b", "blob": "x" * 500})
+        journal.write_record({"event": "c"})
+        journal.close()
+        assert [r["event"] for r in obs.read_journal(path)] == ["a", "b", "c"]
+
+    def test_retention_drops_only_oldest_files(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        journal = obs.JsonlJournal(path, max_bytes=80, max_files=2)
+        for i in range(50):
+            journal.write_record({"event": "e", "i": i})
+        journal.close()
+        records = obs.read_journal(path)
+        # a contiguous, in-order suffix survives
+        assert records, "retention must keep the newest file(s)"
+        idx = [r["i"] for r in records]
+        assert idx == list(range(idx[0], 50))
+        assert len(journal_paths(path)) <= 3  # live + max_files rotations
+
+    def test_concurrent_writers_produce_parseable_lines(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        bus = obs.EventBus()
+        journal = obs.JsonlJournal(path, max_bytes=2_000, max_files=200)
+        bus.subscribe(journal)
+        n_threads, n_per = 4, 100
+
+        def work(tid):
+            for i in range(n_per):
+                bus.emit("job_finished", tid=tid, i=i)
+
+        threads = [
+            threading.Thread(target=work, args=(t,)) for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        journal.close()
+        records = obs.read_journal(path)
+        assert len(records) == n_threads * n_per  # nothing torn or dropped
+
+    def test_ring_buffer_keeps_newest(self):
+        ring = obs.RingBuffer(capacity=3)
+        for i in range(10):
+            ring.append(i)
+        assert ring.snapshot() == [7, 8, 9]
+        assert len(ring) == 3
+
+
+class TestDispatcherDeadLetter:
+    def test_late_result_after_requeue_is_counted_not_lost(self):
+        """A worker dies mid-job; the job is requeued and finishes on a
+        second worker. The first worker's LATE result then arrives for a
+        config id nobody is waiting on — it must land in the dead-letter
+        ring (payload intact) and the obs counter, not vanish."""
+        from hpbandster_tpu.core.job import Job
+        from hpbandster_tpu.parallel.dispatcher import Dispatcher
+
+        d = Dispatcher(run_id="dl-test")
+        delivered = []
+        d._new_result_callback = delivered.append
+
+        cid = (0, 0, 7)
+        job = Job(cid, budget=1.0, config={})
+        job.time_it("submitted")
+        job.time_it("started")
+        d.running_jobs[cid] = job
+
+        before = obs.get_metrics().counter("dispatcher.unknown_results").value
+        # the re-dispatched copy finishes first (normal path)
+        assert d._rpc_register_result(list(cid), {"result": {"loss": 0.5}})
+        assert len(delivered) == 1
+        # ...then the dead first worker's result for the same id limps in
+        late = {"result": {"loss": 0.7}, "exception": None}
+        assert d._rpc_register_result(list(cid), late) is False
+        after = obs.get_metrics().counter("dispatcher.unknown_results").value
+        assert after == before + 1
+        entries = d.dead_letters.snapshot()
+        assert entries[-1]["config_id"] == list(cid)
+        assert entries[-1]["result"]["result"]["loss"] == 0.7
+        # the normal delivery was not disturbed
+        assert delivered[0].result == {"loss": 0.5}
+
+
+class TestAttachProfiler:
+    def _executor(self):
+        class Exec:
+            def __init__(self):
+                self.flushes = 0
+
+            def flush(self):
+                self.flushes += 1
+                return True
+
+        return Exec()
+
+    def test_repeat_attach_does_not_double_wrap(self):
+        from hpbandster_tpu.utils.profiling import (
+            _ORIGINAL_ATTR,
+            attach_profiler,
+        )
+
+        ex = self._executor()
+        original = ex.flush
+        attach_profiler(ex, None)
+        attach_profiler(ex, None)  # idempotent: replaces, never stacks
+        # the installed wrapper points straight at the unwrapped flush
+        # (bound methods compare by __self__/__func__, not identity)
+        assert getattr(ex.flush, _ORIGINAL_ATTR) == original
+        assert ex.flush() is True
+        assert ex.flushes == 1
+
+    def test_detach_restores_original_flush(self):
+        from hpbandster_tpu.utils.profiling import attach_profiler
+
+        ex = self._executor()
+        original = ex.flush
+        detach = attach_profiler(ex, None)
+        assert ex.flush != original
+        detach()
+        detach()  # idempotent
+        assert ex.flush == original
+        assert ex.flush() is True and ex.flushes == 1
+
+    def test_stale_detach_leaves_newer_wrapper_alone(self):
+        from hpbandster_tpu.utils.profiling import attach_profiler
+
+        ex = self._executor()
+        detach_old = attach_profiler(ex, None)
+        detach_old()          # back to the original
+        attach_profiler(ex, None)  # fresh wrapper
+        wrapped = ex.flush
+        detach_old()          # stale handle: must not rip out the new wrapper
+        assert ex.flush is wrapped
+
+
+class TestEndToEndSummarize:
+    def test_bohb_run_journal_summarizes(self, tmp_path, capsys):
+        """Acceptance criterion: a journal from a small end-to-end BOHB run
+        summarizes to per-stage p50/p95 latencies and worker utilization."""
+        from hpbandster_tpu.core.nameserver import NameServer
+        from hpbandster_tpu.core.worker import Worker
+        from hpbandster_tpu.optimizers import BOHB
+
+        from tests.toys import branin_dict, branin_space
+
+        class BraninWorker(Worker):
+            def compute(self, config_id, config, budget, working_directory):
+                return {"loss": branin_dict(config, budget), "info": {}}
+
+        journal_path = str(tmp_path / "journal.jsonl")
+        handle = obs.configure(journal_path=journal_path, ring_capacity=32)
+        ns = NameServer(run_id="obs-e2e", host="127.0.0.1", port=0)
+        host, port = ns.start()
+        try:
+            BraninWorker(
+                run_id="obs-e2e", nameserver=host, nameserver_port=port, id=0
+            ).run(background=True)
+            opt = BOHB(
+                configspace=branin_space(seed=3), run_id="obs-e2e",
+                nameserver=host, nameserver_port=port,
+                min_budget=1, max_budget=9, eta=3, seed=3,
+            )
+            opt.run(n_iterations=1, min_n_workers=1)
+            opt.shutdown(shutdown_workers=True)
+        finally:
+            ns.shutdown()
+            handle.close()
+
+        events = {r["event"] for r in obs.read_journal(journal_path)}
+        assert {"job_submitted", "job_started", "job_finished",
+                "worker_discovered", "bracket_created"} <= events
+
+        assert obs_main(["summarize", journal_path]) == 0
+        out = capsys.readouterr().out
+        assert "p50" in out and "p95" in out
+        assert "queue" in out and "run" in out
+        assert "worker utilization" in out and "utilization" in out
+        assert "unknown results dead-lettered" in out
+
+        # the --json form round-trips and carries the same aggregates
+        assert obs_main(["summarize", journal_path, "--json"]) == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["event_counts"]["job_finished"] >= 1
+        assert summary["stage_latency_s"]["run"]["count"] >= 1
+        assert summary["worker_utilization"], "worker attribution missing"
+
+    def test_summarize_missing_journal_is_usage_error(self, capsys):
+        assert obs_main(["summarize", "/nonexistent/journal.jsonl"]) == 2
