@@ -11,5 +11,6 @@ from hpbandster_tpu.analysis.rules import (  # noqa: F401
     locks,
     markers,
     obs_emit,
+    obs_reserved,
     prng,
 )

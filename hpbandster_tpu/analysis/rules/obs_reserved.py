@@ -1,0 +1,82 @@
+"""obs-reserved-fields — journal schema keys passed as ad-hoc emit kwargs.
+
+The journal record schema reserves ``event``/``t_wall``/``t_mono`` (the
+serializer's own columns) plus the substrate-stamped ``trace_id`` (trace
+context, ``obs/trace.py``) and ``host``/``pid`` (identity static fields,
+``JsonlJournal(static_fields=...)``). A call site that passes one of
+these to ``emit(...)``/``span(...)`` either collides with the stamp or —
+worse — fabricates it: a hand-written ``trace_id`` breaks the cross-host
+join, a hand-written ``host`` lies about where the record came from.
+
+The supported patterns are: enter a trace (``use_trace``) and let
+``make_event`` stamp ``trace_id``; configure identity once
+(``obs.configure(identity=...)`` / ``process_identity()``) and let the
+journal stamp ``host``/``pid``.
+
+Detection mirrors ``obs-emit-in-jit``'s resolution: calls resolving
+through the import map into ``hpbandster_tpu.obs`` (``emit``, ``span``,
+``make_event``, aliased imports), plus ``.emit(...)``/``.span(...)``
+method calls in modules that import ``hpbandster_tpu.obs`` at all —
+flagged only when a reserved name appears among the keywords.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from hpbandster_tpu.analysis.core import Finding, Rule, SourceModule, register
+from hpbandster_tpu.analysis.rules._util import import_map_for
+from hpbandster_tpu.analysis.rules.obs_emit import (
+    _module_imports_obs,
+    _resolves_to_obs,
+)
+
+#: journal-record keys only the substrate may write
+RESERVED_FIELDS = frozenset(
+    {"event", "t_wall", "t_mono", "host", "pid", "trace_id"}
+)
+
+_EMITTING_ATTRS = ("emit", "span")
+
+
+@register
+class ObsReservedFieldsRule(Rule):
+    name = "obs-reserved-fields"
+    description = (
+        "reserved journal field (event/t_wall/t_mono/host/pid/trace_id) "
+        "passed as an ad-hoc emit/span kwarg — these are stamped by the "
+        "substrate (serializer, trace context, identity static fields); "
+        "a call-site copy collides or lies"
+    )
+
+    def check(self, module: SourceModule) -> List[Finding]:
+        # sound prefilter: an obs mention is required for any flaggable call
+        if "obs" not in module.text:
+            return []
+        imports = import_map_for(module)
+        imports_obs = _module_imports_obs(imports)
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            bad = sorted(
+                kw.arg for kw in node.keywords
+                if kw.arg is not None and kw.arg in RESERVED_FIELDS
+            )
+            if not bad:
+                continue
+            if _resolves_to_obs(node.func, imports) or (
+                imports_obs
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _EMITTING_ATTRS
+            ):
+                what = ast.unparse(node.func)
+                findings.append(self.finding(
+                    module, node,
+                    f"{what}(...) passes reserved field(s) "
+                    f"{', '.join(repr(b) for b in bad)} — stamped by the "
+                    "substrate (use_trace / configure(identity=...)), never "
+                    "by the call site",
+                ))
+        return findings

@@ -31,6 +31,11 @@ class Job:
         self.result: Optional[Dict[str, Any]] = None
         self.exception: Optional[str] = None
         self.worker_name: Optional[str] = None
+        #: obs trace identity (hpbandster_tpu.obs.trace.TraceContext) minted
+        #: by the master at submit time; survives requeues, so one trace_id
+        #: tells a job's whole story including redispatch. Never serialized
+        #: into ``timestamps``/result schema.
+        self.trace: Optional[Any] = None
 
     def time_it(self, which_time: str) -> "Job":
         """Record a wall-clock timestamp ('submitted' | 'started' | 'finished')."""

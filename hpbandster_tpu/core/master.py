@@ -149,15 +149,19 @@ class Master:
         """
         # result ingestion is the one point every execution tier funnels
         # through, so job_finished/job_failed (with monotonic queue/run
-        # durations) are emitted here — before the lock: sinks do I/O
-        obs.emit(
-            obs.JOB_FAILED if job.exception is not None else obs.JOB_FINISHED,
-            config_id=list(job.id),
-            budget=job.kwargs.get("budget"),
-            worker=job.worker_name,
-            queue_s=job.mono_duration("submitted", "started"),
-            run_s=job.mono_duration("started", "finished"),
-        )
+        # durations) are emitted here — before the lock: sinks do I/O.
+        # Emitted under the job's own trace (not the ambient one): batched
+        # tiers deliver many jobs from one thread, and each event must
+        # carry its own job's trace_id.
+        with obs.use_trace(getattr(job, "trace", None)):
+            obs.emit(
+                obs.JOB_FAILED if job.exception is not None else obs.JOB_FINISHED,
+                config_id=list(job.id),
+                budget=job.kwargs.get("budget"),
+                worker=job.worker_name,
+                queue_s=job.mono_duration("submitted", "started"),
+                run_s=job.mono_duration("started", "finished"),
+            )
         with self.thread_cond:
             self.num_running_jobs -= 1
             if self.result_logger is not None:
@@ -188,8 +192,12 @@ class Master:
             "budgets": tuple(it.budgets),
             "stage": it.stage,
         }
+        # mint the job's trace identity here — the one id that survives the
+        # master -> dispatcher -> worker -> result round-trip (obs/trace.py)
+        job.trace = obs.new_trace(self.run_id)
         job.time_it("submitted")
-        obs.emit(obs.JOB_SUBMITTED, config_id=list(config_id), budget=budget)
+        with obs.use_trace(job.trace):
+            obs.emit(obs.JOB_SUBMITTED, config_id=list(config_id), budget=budget)
         with self.thread_cond:
             self.num_running_jobs += 1
             self.jobs.append(job)

@@ -10,6 +10,17 @@ nameserver or a shared-directory credentials file.
 Transport is the stdlib TCP RPC layer instead of Pyro4; semantics kept:
 one job at a time, exceptions captured as traceback strings, results pushed
 back to the dispatcher's callback URI, optional idle-timeout self-shutdown.
+
+Worker-side observability (docs/observability.md "Trace propagation"):
+the dispatcher's ``start_computation`` call carries the job's trace in the
+``_obs`` envelope; the RPC handler enters it, :meth:`_rpc_start_computation`
+captures it (threads do NOT inherit contextvars) and the compute thread
+re-enters it — so every worker event carries the same ``trace_id`` the
+master minted. Pass ``journal_path`` to give the worker its OWN journal,
+stamped with ``{host, pid, worker_id}``: merged with the master's via
+``python -m hpbandster_tpu.obs summarize a.jsonl b.jsonl`` it yields the
+cross-host per-job timeline. Result delivery retries with capped
+exponential backoff before a computed result is ever abandoned.
 """
 
 from __future__ import annotations
@@ -23,7 +34,16 @@ import time
 import traceback
 from typing import Any, Dict, Optional
 
-from hpbandster_tpu.parallel.rpc import RPCProxy, RPCServer, format_uri
+from hpbandster_tpu import obs
+from hpbandster_tpu.obs.events import make_event
+from hpbandster_tpu.obs.journal import event_to_record
+from hpbandster_tpu.parallel.rpc import (
+    CommunicationError,
+    RPCError,
+    RPCProxy,
+    RPCServer,
+    format_uri,
+)
 
 __all__ = ["Worker"]
 
@@ -38,6 +58,7 @@ class Worker:
         host: Optional[str] = None,
         id: Optional[Any] = None,
         timeout: Optional[float] = None,
+        journal_path: Optional[str] = None,
     ):
         self.run_id = run_id
         self.nameserver = nameserver
@@ -59,6 +80,17 @@ class Worker:
         self._shutdown_event = threading.Event()
         self._last_active = time.time()
         self._timeout_thread: Optional[threading.Thread] = None
+
+        # ---- observability: worker-local journal / ring / health -------
+        #: result-delivery retry policy (capped exponential backoff) — a
+        #: computed result is only abandoned after every attempt fails
+        self.result_delivery_attempts = 4
+        self.result_delivery_backoff = 0.5
+        self.result_delivery_backoff_cap = 8.0
+        self.journal_path = journal_path
+        self._journal: Optional[obs.JsonlJournal] = None
+        self._ring = obs.RingBuffer(capacity=64)
+        self._current_job: Optional[Any] = None  # config_id while computing
 
     # -------------------------------------------------------------- bootstrap
     def load_nameserver_credentials(
@@ -86,11 +118,23 @@ class Worker:
         until shutdown."""
         if self.nameserver is None:
             raise RuntimeError("no nameserver specified (or credentials loaded)")
+        if self.journal_path is not None and self._journal is None:
+            # the worker's own half of the distributed story: every record
+            # stamped with this process's identity (merge-ready)
+            self._journal = obs.JsonlJournal(
+                self.journal_path, static_fields=self.identity()
+            )
         self._server = RPCServer(self.host, 0)
         self._server.register("start_computation", self._rpc_start_computation)
         self._server.register("is_busy", self._rpc_is_busy)
         self._server.register("shutdown", self._rpc_shutdown)
         self._server.register("ping", lambda: "pong")
+        obs.HealthEndpoint(
+            component="worker",
+            identity=self.identity(),
+            ring=self._ring,
+            in_flight=self._health_in_flight,
+        ).register(self._server)
         self._extra_rpc(self._server)
         self._server.start()
 
@@ -128,6 +172,8 @@ class Worker:
         if self._server is not None:
             self._server.shutdown()
             self._server = None
+        if self._journal is not None:
+            self._journal.close()
 
     def shutdown(self) -> None:
         self._shutdown_event.set()
@@ -149,49 +195,151 @@ class Worker:
         self.shutdown()
         return True
 
+    # ------------------------------------------------------- observability
+    def identity(self) -> Dict[str, Any]:
+        """This worker process's static identity stamp (journal records,
+        health snapshots): ``{host, pid, worker_id}``."""
+        return obs.process_identity(worker_id=self.worker_id)
+
+    def _health_in_flight(self) -> Optional[list]:
+        cj = self._current_job  # one read: the compute thread may clear it
+        return list(cj) if cj is not None else None
+
+    def _emit(self, name: str, **fields: Any) -> None:
+        """Worker-side event emission: into the worker's own journal when
+        one is configured (its half of a merged cross-host timeline, with
+        the current trace_id stamped by ``make_event``), otherwise onto
+        the process bus; the health ring always keeps the newest few.
+
+        Never raises: a full disk or closed journal must not wedge the
+        busy lock or strand a computed result — the same shielding the
+        EventBus gives its sinks."""
+        if not obs.enabled():
+            return
+        try:
+            ev = make_event(name, fields)
+            self._ring.append(event_to_record(ev))
+            if self._journal is not None:
+                self._journal(ev)
+            else:
+                obs.get_bus().publish(ev)
+        except Exception:
+            self.logger.exception("worker obs emit %s failed", name)
+
+    # ------------------------------------------------------------- compute
     def _rpc_start_computation(
         self, callback_uri: str, id: Any, **job_kwargs: Any
     ) -> bool:
         if not self._busy_lock.acquire(blocking=False):
             raise RuntimeError("worker is busy")
         self._last_active = time.time()
+        self._current_job = tuple(id)
+        # threads do not inherit contextvars: capture the trace the RPC
+        # handler extracted from the _obs envelope and hand it to the
+        # compute thread explicitly
         thread = threading.Thread(
             target=self._run_job,
-            args=(callback_uri, tuple(id), job_kwargs),
+            args=(callback_uri, tuple(id), job_kwargs, obs.current_trace()),
             daemon=True,
             name=f"compute-{id}",
         )
         thread.start()
         return True
 
-    def _run_job(self, callback_uri: str, config_id: Any, job_kwargs: Dict[str, Any]) -> None:
-        result: Optional[Dict[str, Any]] = None
-        exception: Optional[str] = None
-        try:
-            result = self.compute(config_id=config_id, **job_kwargs)
-            if not isinstance(result, dict) or "loss" not in result:
-                raise TypeError(
-                    "compute() must return a dict with a 'loss' key, got "
-                    f"{type(result).__name__}"
+    def _run_job(
+        self,
+        callback_uri: str,
+        config_id: Any,
+        job_kwargs: Dict[str, Any],
+        trace_ctx: Optional[obs.TraceContext] = None,
+    ) -> None:
+        with obs.use_trace(trace_ctx):
+            self._emit(
+                obs.JOB_STARTED,
+                config_id=list(config_id), budget=job_kwargs.get("budget"),
+            )
+            result: Optional[Dict[str, Any]] = None
+            exception: Optional[str] = None
+            t0 = time.monotonic()
+            try:
+                result = self.compute(config_id=config_id, **job_kwargs)
+                if not isinstance(result, dict) or "loss" not in result:
+                    raise TypeError(
+                        "compute() must return a dict with a 'loss' key, got "
+                        f"{type(result).__name__}"
+                    )
+            except Exception:
+                result = None
+                exception = traceback.format_exc()
+                self.logger.warning("compute crashed:\n%s", exception)
+            finally:
+                compute_s = time.monotonic() - t0
+                self._last_active = time.time()
+                # guarded: once the busy lock is released a NEW job may
+                # already own the marker while this thread is still in
+                # delivery backoff — never clobber it
+                if self._current_job == tuple(config_id):
+                    self._current_job = None
+                self._busy_lock.release()
+            self._emit(
+                obs.JOB_FAILED if exception is not None else obs.JOB_FINISHED,
+                config_id=list(config_id), budget=job_kwargs.get("budget"),
+                compute_s=round(compute_s, 6),
+            )
+            self._deliver_result(
+                callback_uri, config_id,
+                {"result": result, "exception": exception},
+            )
+
+    def _deliver_result(
+        self, callback_uri: str, config_id: Any, payload: Dict[str, Any]
+    ) -> bool:
+        """Push the result to the dispatcher, retrying transient failures
+        with capped exponential backoff — a single failed RPC must not
+        strand a result the worker already paid to compute."""
+        t0 = time.monotonic()
+        delay = self.result_delivery_backoff
+        attempts = max(int(self.result_delivery_attempts), 1)
+        for attempt in range(1, attempts + 1):
+            try:
+                RPCProxy(callback_uri, timeout=30).call(
+                    "register_result", id=list(config_id), result=payload
                 )
-        except Exception:
-            result = None
-            exception = traceback.format_exc()
-            self.logger.warning("compute crashed:\n%s", exception)
-        finally:
-            self._last_active = time.time()
-            self._busy_lock.release()
-        try:
-            RPCProxy(callback_uri, timeout=30).call(
-                "register_result",
-                id=list(config_id),
-                result={"result": result, "exception": exception},
-            )
-        except Exception:
-            self.logger.error(
-                "could not deliver result for %s:\n%s",
-                config_id, traceback.format_exc(),
-            )
+            # broad on purpose (matches the pre-retry behavior): a
+            # serialization TypeError must be logged and counted like any
+            # transport failure, not kill the compute thread silently —
+            # the attempt cap bounds pointless retries either way
+            except Exception as e:
+                if attempt >= attempts:
+                    obs.get_metrics().counter(
+                        "worker.result_delivery_failures"
+                    ).inc()
+                    self.logger.error(
+                        "could not deliver result for %s after %d attempts:\n%s",
+                        config_id, attempt, traceback.format_exc(),
+                    )
+                    return False
+                obs.get_metrics().counter("worker.result_delivery_retries").inc()
+                self._emit(
+                    obs.RPC_RETRY,
+                    config_id=list(config_id), attempt=attempt,
+                    max_attempts=attempts, error=type(e).__name__,
+                )
+                self.logger.warning(
+                    "register_result %d/%d for %s failed (%r); retrying in %.2fs",
+                    attempt, attempts, config_id, e, delay,
+                )
+                time.sleep(delay)
+                delay = min(delay * 2.0, self.result_delivery_backoff_cap)
+            else:
+                self._emit(
+                    obs.RESULT_DELIVERED,
+                    config_id=list(config_id),
+                    delivery_s=round(time.monotonic() - t0, 6),
+                    attempts=attempt,
+                )
+                return True
+        return False
 
     # --------------------------------------------------------------- user API
     def compute(

@@ -10,9 +10,17 @@ emit into (see docs/observability.md):
   :func:`span` regions, with ``utils/profiling.py`` as the optional
   ``jax.profiler`` span backend;
 * :mod:`~hpbandster_tpu.obs.journal` — rotating JSONL run journal +
-  in-memory ring buffer for post-mortems;
-* ``python -m hpbandster_tpu.obs summarize <journal>`` — per-stage
-  latency percentiles, worker utilization, failure tallies.
+  in-memory ring buffer for post-mortems, identity-stamped via
+  ``static_fields`` / ``configure(identity=...)``;
+* :mod:`~hpbandster_tpu.obs.trace` — per-job trace context propagated
+  across RPC hops (the ``_obs`` envelope in ``parallel/rpc.py``), stamped
+  onto every event as ``trace_id``;
+* :mod:`~hpbandster_tpu.obs.health` — the ``obs_snapshot`` fleet-health
+  RPC endpoint + :func:`install_crash_dump` forensics;
+* ``python -m hpbandster_tpu.obs summarize <journal> [<journal> ...]`` —
+  per-stage latency percentiles, worker utilization, failure tallies, and
+  merged cross-host per-trace timelines; ``watch <journal>`` tails a live
+  run.
 
 Everything here is stdlib-only and costs ~nothing when no sink is
 attached (the bench's ``obs_overhead`` tier measures exactly that), so
@@ -32,7 +40,7 @@ Quick start::
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Union
 
 from hpbandster_tpu.obs import events as _events
 from hpbandster_tpu.obs import metrics as _metrics
@@ -45,6 +53,7 @@ from hpbandster_tpu.obs.events import (  # noqa: F401
     JOB_STARTED,
     JOB_SUBMITTED,
     KDE_REFIT,
+    RESULT_DELIVERED,
     RPC_RETRY,
     UNKNOWN_RESULT,
     WORKER_DISCOVERED,
@@ -53,12 +62,18 @@ from hpbandster_tpu.obs.events import (  # noqa: F401
     EventBus,
     emit,
     get_bus,
+    make_event,
     span,
     use_jax_annotations,
+)
+from hpbandster_tpu.obs.health import (  # noqa: F401
+    HealthEndpoint,
+    install_crash_dump,
 )
 from hpbandster_tpu.obs.journal import (  # noqa: F401
     JsonlJournal,
     RingBuffer,
+    process_identity,
     read_journal,
 )
 from hpbandster_tpu.obs.metrics import (  # noqa: F401
@@ -68,16 +83,28 @@ from hpbandster_tpu.obs.metrics import (  # noqa: F401
     MetricsRegistry,
     get_metrics,
 )
+from hpbandster_tpu.obs.trace import (  # noqa: F401
+    TraceContext,
+    current_trace,
+    current_wire,
+    extract_wire,
+    new_trace,
+    use_trace,
+)
 
 __all__ = [
-    "Event", "EventBus", "emit", "get_bus", "span", "use_jax_annotations",
-    "JsonlJournal", "RingBuffer", "read_journal",
+    "Event", "EventBus", "emit", "make_event", "get_bus", "span",
+    "use_jax_annotations",
+    "JsonlJournal", "RingBuffer", "read_journal", "process_identity",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_metrics",
+    "TraceContext", "new_trace", "current_trace", "use_trace",
+    "current_wire", "extract_wire",
+    "HealthEndpoint", "install_crash_dump",
     "configure", "set_enabled", "enabled",
     "EVENT_TYPES", "JOB_SUBMITTED", "JOB_STARTED", "JOB_FINISHED",
     "JOB_FAILED", "WORKER_DISCOVERED", "WORKER_DROPPED",
-    "BRACKET_PROMOTION", "KDE_REFIT", "RPC_RETRY", "CHECKPOINT_WRITTEN",
-    "UNKNOWN_RESULT",
+    "BRACKET_PROMOTION", "KDE_REFIT", "RPC_RETRY", "RESULT_DELIVERED",
+    "CHECKPOINT_WRITTEN", "UNKNOWN_RESULT",
 ]
 
 
@@ -121,12 +148,17 @@ def configure(
     journal_max_bytes: int = 16 * 1024 * 1024,
     journal_max_files: int = 3,
     ring_capacity: int = 0,
+    identity: Union[bool, Dict[str, Any], None] = None,
     bus: Optional[EventBus] = None,
 ) -> ObsHandle:
     """Attach the standard sinks to ``bus`` (default: the process bus).
 
     ``journal_path`` enables the rotating JSONL journal; ``ring_capacity
     > 0`` additionally keeps the newest events in memory for post-mortems.
+    ``identity`` stamps every journal record with this process's identity:
+    ``True`` for the automatic ``{host, pid}`` pair, or a dict of extra
+    fields (``{"worker_id": ...}``) merged over it — the stamp that lets
+    ``summarize a.jsonl b.jsonl`` attribute merged cross-host records.
     Returns an :class:`ObsHandle` — close it to detach (tests and
     multi-run processes must, or sinks accumulate)."""
     bus = bus if bus is not None else get_bus()
@@ -134,9 +166,14 @@ def configure(
     journal = None
     ring = None
     if journal_path is not None:
+        static = None
+        if identity:
+            static = process_identity(
+                **(identity if isinstance(identity, dict) else {})
+            )
         journal = JsonlJournal(
             journal_path, max_bytes=journal_max_bytes,
-            max_files=journal_max_files,
+            max_files=journal_max_files, static_fields=static,
         )
         detachers.append(bus.subscribe(journal))
     if ring_capacity > 0:

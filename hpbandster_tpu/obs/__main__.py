@@ -1,4 +1,11 @@
-"""CLI: ``python -m hpbandster_tpu.obs summarize <journal> [--json]``.
+"""CLI: ``python -m hpbandster_tpu.obs <command>``.
+
+* ``summarize <journal> [<journal> ...] [--json]`` — merge one or many
+  (possibly rotated) journals by wall clock; print per-stage latency
+  percentiles, worker utilization, failure tallies, and the merged
+  per-trace timelines (queue wait -> dispatch -> compute -> delivery).
+* ``watch <journal> [--interval S] [--ticks N]`` — tail a live journal,
+  one status line per tick; runs until ^C unless ``--ticks`` bounds it.
 
 Exit codes: 0 success, 2 usage error / unreadable journal.
 """
@@ -11,8 +18,13 @@ import os
 import sys
 from typing import List, Optional
 
-from hpbandster_tpu.obs.journal import journal_paths, read_journal
-from hpbandster_tpu.obs.summarize import format_summary, summarize_records
+from hpbandster_tpu.obs.journal import journal_paths
+from hpbandster_tpu.obs.summarize import (
+    format_summary,
+    read_merged,
+    summarize_records,
+    watch_journal,
+)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -23,19 +35,44 @@ def main(argv: Optional[List[str]] = None) -> int:
     sub = parser.add_subparsers(dest="command", required=True)
     p_sum = sub.add_parser(
         "summarize",
-        help="per-stage latency percentiles, worker utilization, failures",
+        help="per-stage latency percentiles, worker utilization, failures, "
+        "and merged per-trace timelines",
     )
-    p_sum.add_argument("journal", help="path to a JSONL run journal")
+    p_sum.add_argument(
+        "journals", nargs="+", metavar="journal",
+        help="JSONL run journal(s) — e.g. the master's and each worker's",
+    )
     p_sum.add_argument(
         "--json", action="store_true", dest="as_json",
         help="emit the summary as JSON instead of text",
     )
+    p_watch = sub.add_parser(
+        "watch", help="tail a live journal, one status line per tick"
+    )
+    p_watch.add_argument("journal", help="path to a (possibly future) journal")
+    p_watch.add_argument(
+        "--interval", type=float, default=2.0, help="seconds between ticks"
+    )
+    p_watch.add_argument(
+        "--ticks", type=int, default=None,
+        help="stop after N ticks (default: run until ^C)",
+    )
     args = parser.parse_args(argv)
 
-    if not os.path.exists(args.journal) and not journal_paths(args.journal):
-        print(f"error: journal {args.journal!r} does not exist", file=sys.stderr)
+    if args.command == "watch":
+        return watch_journal(args.journal, interval=args.interval, ticks=args.ticks)
+
+    missing = [
+        p for p in args.journals
+        if not os.path.exists(p) and not journal_paths(p)
+    ]
+    if missing:
+        print(
+            f"error: journal(s) {', '.join(repr(p) for p in missing)} do not exist",
+            file=sys.stderr,
+        )
         return 2
-    summary = summarize_records(read_journal(args.journal))
+    summary = summarize_records(read_merged(args.journals))
     if args.as_json:
         print(json.dumps(summary, indent=1))
     else:

@@ -31,11 +31,14 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
+from hpbandster_tpu.obs.trace import current_trace
+
 __all__ = [
     "Event",
     "EventBus",
     "get_bus",
     "emit",
+    "make_event",
     "span",
     "use_jax_annotations",
     "EVENT_TYPES",
@@ -48,6 +51,7 @@ __all__ = [
     "BRACKET_PROMOTION",
     "KDE_REFIT",
     "RPC_RETRY",
+    "RESULT_DELIVERED",
     "CHECKPOINT_WRITTEN",
     "UNKNOWN_RESULT",
 ]
@@ -64,6 +68,7 @@ WORKER_DROPPED = "worker_dropped"
 BRACKET_PROMOTION = "bracket_promotion"
 KDE_REFIT = "kde_refit"
 RPC_RETRY = "rpc_retry"
+RESULT_DELIVERED = "result_delivered"
 CHECKPOINT_WRITTEN = "checkpoint_written"
 UNKNOWN_RESULT = "unknown_result"
 
@@ -73,7 +78,7 @@ UNKNOWN_RESULT = "unknown_result"
 EVENT_TYPES = frozenset({
     JOB_SUBMITTED, JOB_STARTED, JOB_FINISHED, JOB_FAILED,
     WORKER_DISCOVERED, WORKER_DROPPED, BRACKET_PROMOTION, KDE_REFIT,
-    RPC_RETRY, CHECKPOINT_WRITTEN, UNKNOWN_RESULT,
+    RPC_RETRY, RESULT_DELIVERED, CHECKPOINT_WRITTEN, UNKNOWN_RESULT,
 })
 
 #: process-wide kill switch (hpbandster_tpu.obs.set_enabled)
@@ -96,6 +101,18 @@ class Event:
 
 
 Sink = Callable[[Event], None]
+
+
+def make_event(name: str, fields: Dict[str, Any]) -> Event:
+    """Construct one stamped :class:`Event`: wall + monotonic clocks, and
+    the current trace's ``trace_id`` (see :mod:`~hpbandster_tpu.obs.trace`)
+    folded into the fields. The one place trace stamping happens — call
+    sites never pass ``trace_id`` by hand (``obs-reserved-fields`` rule).
+    """
+    tc = current_trace()
+    if tc is not None and "trace_id" not in fields:
+        fields = dict(fields, trace_id=tc.trace_id)
+    return Event(name, time.time(), time.monotonic(), fields)
 
 
 class EventBus:
@@ -126,16 +143,31 @@ class EventBus:
         return detach
 
     def emit(self, name: str, **fields: Any) -> Optional[Event]:
-        """Deliver one event; returns it, or None when nobody listens."""
+        """Deliver one event; returns it, or None when nobody listens.
+        The Event (and its trace stamp) is only constructed when a sink
+        will actually see it — the no-sink path stays ~free."""
         sinks = self._sinks  # graftlint: disable=lock-coverage — copy-on-write tuple: an unlocked read sees a complete old/new tuple
         if not sinks or not _ENABLED:
             return None
-        ev = Event(name, time.time(), time.monotonic(), fields)
+        ev = make_event(name, fields)
         for sink in sinks:
             try:
                 sink(ev)
             except Exception:
                 logger.exception("obs sink %r failed on %s", sink, name)
+        return ev
+
+    def publish(self, ev: Event) -> Optional[Event]:
+        """Deliver a pre-built :class:`Event` (e.g. one a worker already
+        wrote to its local journal) to the current sinks."""
+        sinks = self._sinks  # graftlint: disable=lock-coverage — copy-on-write tuple: an unlocked read sees a complete old/new tuple
+        if not sinks or not _ENABLED:
+            return None
+        for sink in sinks:
+            try:
+                sink(ev)
+            except Exception:
+                logger.exception("obs sink %r failed on %s", sink, ev.name)
         return ev
 
 

@@ -21,12 +21,16 @@ from __future__ import annotations
 import collections
 import json
 import os
+import socket
 import threading
 from typing import Any, Dict, List, Optional
 
 from hpbandster_tpu.obs.events import Event
 
-__all__ = ["JsonlJournal", "RingBuffer", "journal_paths", "read_journal"]
+__all__ = [
+    "JsonlJournal", "RingBuffer", "journal_paths", "read_journal",
+    "process_identity",
+]
 
 
 def _jsonable(x: Any) -> Any:
@@ -39,11 +43,22 @@ def _jsonable(x: Any) -> Any:
 
 def event_to_record(ev: Event) -> Dict[str, Any]:
     """The on-disk schema: event name + stamps flattened with the fields
-    (field names never collide — ``event``/``t_wall``/``t_mono`` are
-    reserved, docs/observability.md)."""
+    (field names never collide — ``event``/``t_wall``/``t_mono``, plus the
+    identity/trace stamps ``host``/``pid``/``trace_id``, are reserved,
+    docs/observability.md)."""
     rec = {"event": ev.name, "t_wall": ev.t_wall, "t_mono": ev.t_mono}
     rec.update(ev.fields)
     return rec
+
+
+def process_identity(**extra: Any) -> Dict[str, Any]:
+    """The standard per-process identity stamp for
+    ``JsonlJournal(static_fields=...)``: ``{host, pid}`` plus any
+    caller-specific fields (``worker_id``, ``component``, ...). Merged
+    journals from many hosts stay attributable record by record."""
+    ident: Dict[str, Any] = {"host": socket.gethostname(), "pid": os.getpid()}
+    ident.update(extra)
+    return ident
 
 
 class RingBuffer:
@@ -79,12 +94,16 @@ class JsonlJournal:
         path: str,
         max_bytes: int = 16 * 1024 * 1024,
         max_files: int = 3,
+        static_fields: Optional[Dict[str, Any]] = None,
     ):
         if max_bytes <= 0:
             raise ValueError("max_bytes must be positive")
         self.path = path
         self.max_bytes = int(max_bytes)
         self.max_files = max(int(max_files), 1)
+        #: identity stamp merged into every record (record keys win) —
+        #: see :func:`process_identity`
+        self.static_fields = dict(static_fields) if static_fields else None
         self._lock = threading.Lock()
         directory = os.path.dirname(os.path.abspath(path))
         os.makedirs(directory, exist_ok=True)
@@ -97,6 +116,10 @@ class JsonlJournal:
         self.write_record(event_to_record(event))
 
     def write_record(self, record: Dict[str, Any]) -> None:
+        if self.static_fields:
+            record = dict(record)
+            for k, v in self.static_fields.items():
+                record.setdefault(k, v)
         line = json.dumps(record, default=_jsonable) + "\n"
         data = line.encode("utf-8")
         with self._lock:

@@ -221,6 +221,13 @@ class MetricsRegistry:
                     }
         return out
 
+    def remove(self, name: str) -> bool:
+        """Drop one instrument (e.g. a per-worker gauge when the worker
+        leaves the pool — without this, elastic churn leaks stale frozen
+        metrics without bound). Returns whether it existed."""
+        with self._lock:
+            return self._instruments.pop(name, None) is not None
+
     def reset(self) -> None:
         """Drop every instrument (test isolation)."""
         with self._lock:

@@ -1,6 +1,8 @@
 """Journal post-processing: ``python -m hpbandster_tpu.obs summarize``.
 
-Reads a (possibly rotated) JSONL run journal and prints the run's shape:
+Reads one or MANY (possibly rotated) JSONL run journals — e.g. the
+master's and each worker's — merges them by wall clock, and prints the
+run's shape:
 
 * **per-stage latencies** — p50/p95 over the ``queue_s`` (submitted ->
   started) and ``run_s`` (started -> finished) durations carried by
@@ -10,23 +12,55 @@ Reads a (possibly rotated) JSONL run journal and prints the run's shape:
 * **worker utilization** — per worker, busy seconds (sum of ``run_s``)
   over the journal's wall-clock window, with jobs/failures tallied;
 * **failure tallies** — failed jobs, RPC retries, dropped workers,
-  dead-lettered unknown results.
+  dead-lettered unknown results;
+* **per-trace timelines** — records sharing a ``trace_id`` (one job's
+  round-trip, see ``obs/trace.py``) joined across journals into a
+  queue-wait -> dispatch -> compute -> delivery stage breakdown, with the
+  set of hosts each trace touched.
 
 Durations are computed at the EMITTING site from monotonic clocks and
 carried in the events, so the summary never subtracts wall-clock stamps
-(immune to clock jumps) and never has to join event streams across
-processes.
+(immune to clock jumps) and never compares monotonic clocks across
+processes — the cross-host join is on ``trace_id``, and wall clock only
+orders the display.
+
+:func:`watch_journal` is the live counterpart: tail a journal as the run
+writes it, rendering a one-line status per tick (survives rotation and a
+not-yet-created file).
 """
 
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, Iterable, List, Optional
+import os
+import sys
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, TextIO
 
 from hpbandster_tpu.obs import events as E
 from hpbandster_tpu.obs.journal import read_journal
 
-__all__ = ["summarize_records", "format_summary", "summarize_path"]
+__all__ = [
+    "summarize_records", "format_summary", "summarize_path",
+    "read_merged", "trace_timelines", "watch_journal",
+]
+
+#: journal-record fields -> timeline stage names (the emitting sites:
+#: dispatcher JOB_STARTED, worker JOB_FINISHED/JOB_FAILED, worker
+#: RESULT_DELIVERED, master JOB_FINISHED/JOB_FAILED)
+_STAGE_FIELDS = (
+    ("queue_wait_s", "queue_wait_s"),
+    ("dispatch_s", "dispatch_s"),
+    ("compute_s", "compute_s"),
+    ("delivery_s", "delivery_s"),
+    ("run_s", "end_to_end_s"),
+)
+
+#: events both the master side and the worker side emit for the SAME job
+#: (same trace_id) — counted once per (event, trace_id) in summaries
+_JOB_LIFECYCLE_EVENTS = frozenset(
+    {E.JOB_SUBMITTED, E.JOB_STARTED, E.JOB_FINISHED, E.JOB_FAILED}
+)
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -50,8 +84,95 @@ def _stats(vals: Iterable[float]) -> Optional[Dict[str, Any]]:
     }
 
 
+def read_merged(paths: Sequence[str]) -> List[Dict[str, Any]]:
+    """Records of N journals merged oldest-first by wall clock (the only
+    cross-process ordering available; durations never derive from it)."""
+    records: List[Dict[str, Any]] = []
+    for p in paths:
+        records.extend(read_journal(p))
+    records.sort(key=lambda r: r.get("t_wall") if isinstance(r.get("t_wall"), (int, float)) else 0.0)
+    return records
+
+
+def trace_timelines(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Join records by ``trace_id`` into per-job timelines.
+
+    Each timeline carries the stage durations measured at their emitting
+    sites (queue wait and dispatch on the master/dispatcher side, compute
+    and delivery on the worker side, end-to-end back on the master), the
+    hosts that contributed records, retry/failure flags, and the journal
+    wall-clock span. Cross-trace aggregates ride along as
+    ``stage_latency_s``.
+    """
+    traces: Dict[str, Dict[str, Any]] = {}
+    for rec in records:
+        tid = rec.get("trace_id")
+        if not isinstance(tid, str) or not tid:
+            continue
+        slot = traces.setdefault(tid, {
+            "trace_id": tid,
+            "config_id": None,
+            "events": 0,
+            "hosts": set(),
+            "stages": {},
+            "retries": 0,
+            "failed": False,
+            "dead_lettered": False,
+            "t_first": None,
+            "t_last": None,
+        })
+        slot["events"] += 1
+        tw = rec.get("t_wall")
+        if isinstance(tw, (int, float)):
+            slot["t_first"] = tw if slot["t_first"] is None else min(slot["t_first"], tw)
+            slot["t_last"] = tw if slot["t_last"] is None else max(slot["t_last"], tw)
+        host = rec.get("host")
+        if host:
+            slot["hosts"].add(str(host))
+        if slot["config_id"] is None and rec.get("config_id") is not None:
+            slot["config_id"] = rec["config_id"]
+        name = rec.get("event")
+        if name == E.RPC_RETRY:
+            slot["retries"] += 1
+        elif name == E.JOB_FAILED:
+            slot["failed"] = True
+        elif name == E.UNKNOWN_RESULT:
+            slot["dead_lettered"] = True
+        for field, stage in _STAGE_FIELDS:
+            v = rec.get(field)
+            if isinstance(v, (int, float)):
+                # keep the LAST occurrence: a requeued job's second
+                # dispatch is the one that produced the result
+                slot["stages"][stage] = float(v)
+
+    timelines = []
+    stage_vals: Dict[str, List[float]] = {}
+    for slot in sorted(
+        traces.values(), key=lambda s: (s["t_first"] is None, s["t_first"] or 0.0)
+    ):
+        slot["hosts"] = sorted(slot["hosts"])
+        timelines.append(slot)
+        for stage, v in slot["stages"].items():
+            stage_vals.setdefault(stage, []).append(v)
+    return {
+        "count": len(timelines),
+        "stage_latency_s": {
+            stage: _stats(vals) for stage, vals in sorted(stage_vals.items())
+        },
+        "timelines": timelines,
+    }
+
+
 def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
-    """Aggregate journal records into the summary dict the CLI renders."""
+    """Aggregate journal records into the summary dict the CLI renders.
+
+    Merged journals tell each job's story twice — the master/dispatcher
+    side and the worker side both emit ``job_*`` under the same names —
+    so job lifecycle counts are deduplicated on ``(event, trace_id)``:
+    one job, one count, regardless of how many journals witnessed it.
+    (Field extraction is NOT deduplicated: the master record carries
+    ``queue_s``/``run_s``, the worker record ``compute_s`` — both feed
+    the stage and timeline aggregates.)"""
     counts: Dict[str, int] = {}
     queue_s: List[float] = []
     run_s: List[float] = []
@@ -59,6 +180,7 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     workers: Dict[str, Dict[str, float]] = {}
     t_wall_min: Optional[float] = None
     t_wall_max: Optional[float] = None
+    seen_job_keys: set = set()
 
     def worker_slot(name: str) -> Dict[str, float]:
         return workers.setdefault(
@@ -69,7 +191,14 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         name = rec.get("event")
         if not name:
             continue
-        counts[name] = counts.get(name, 0) + 1
+        tid = rec.get("trace_id")
+        if name in _JOB_LIFECYCLE_EVENTS and isinstance(tid, str) and tid:
+            key = (name, tid)
+            if key not in seen_job_keys:
+                seen_job_keys.add(key)
+                counts[name] = counts.get(name, 0) + 1
+        else:
+            counts[name] = counts.get(name, 0) + 1
         tw = rec.get("t_wall")
         if isinstance(tw, (int, float)):
             t_wall_min = tw if t_wall_min is None else min(t_wall_min, tw)
@@ -129,11 +258,13 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             "workers_dropped": counts.get(E.WORKER_DROPPED, 0),
             "unknown_results_dead_lettered": counts.get(E.UNKNOWN_RESULT, 0),
         },
+        "traces": trace_timelines(records),
     }
 
 
-def summarize_path(path: str) -> Dict[str, Any]:
-    return summarize_records(read_journal(path))
+def summarize_path(path: "str | Sequence[str]") -> Dict[str, Any]:
+    paths = [path] if isinstance(path, str) else list(path)
+    return summarize_records(read_merged(paths))
 
 
 def format_summary(s: Dict[str, Any]) -> str:
@@ -170,6 +301,156 @@ def format_summary(s: Dict[str, Any]) -> str:
             f["workers_dropped"], f["unknown_results_dead_lettered"],
         )
     )
+    traces = s.get("traces") or {}
+    if traces.get("count"):
+        lines.append("")
+        lines.append(f"trace timelines ({traces['count']} traces):")
+        lines.append(
+            f"  {'trace':<18} {'config':<12} {'queue_wait':>10} {'dispatch':>9} "
+            f"{'compute':>9} {'delivery':>9} {'end_to_end':>10}  hosts"
+        )
+
+        def cell(st: Dict[str, Any], key: str) -> str:
+            v = st.get(key)
+            return f"{v:.4f}" if isinstance(v, (int, float)) else "-"
+
+        shown = traces["timelines"][:_MAX_TIMELINE_ROWS]
+        for t in shown:
+            flags = "".join(
+                mark for mark, on in (
+                    ("!", t["failed"]), ("r", t["retries"] > 0),
+                    ("d", t["dead_lettered"]),
+                ) if on
+            )
+            st = t["stages"]
+            lines.append(
+                f"  {t['trace_id'] + flags:<18} {json.dumps(t['config_id']):<12} "
+                f"{cell(st, 'queue_wait_s'):>10} {cell(st, 'dispatch_s'):>9} "
+                f"{cell(st, 'compute_s'):>9} {cell(st, 'delivery_s'):>9} "
+                f"{cell(st, 'end_to_end_s'):>10}  {','.join(t['hosts']) or '-'}"
+            )
+        if len(traces["timelines"]) > len(shown):
+            lines.append(
+                f"  ... {len(traces['timelines']) - len(shown)} more "
+                "(use --json for all)"
+            )
+        lines.append("  per-stage across traces (p50/p95/max):")
+        for stage, st in traces["stage_latency_s"].items():
+            lines.append(
+                f"    {stage:<14} {st['count']:>5} traces "
+                f"{st['p50']:>10.4f} {st['p95']:>10.4f} {st['max']:>10.4f}"
+            )
     lines.append("")
     lines.append("event counts: " + json.dumps(s["event_counts"]))
     return "\n".join(lines)
+
+
+#: format_summary caps the per-trace table; --json carries every timeline
+_MAX_TIMELINE_ROWS = 20
+
+
+# ------------------------------------------------------------------ watch
+class _WatchState:
+    """Rolling tallies behind one status line of ``watch``."""
+
+    def __init__(self) -> None:
+        self.events = 0
+        self.counts: Dict[str, int] = {}
+        self.workers: set = set()
+        self.last_name: Optional[str] = None
+        self.last_t_wall: Optional[float] = None
+        self._seen_job_keys: set = set()
+
+    def update(self, rec: Dict[str, Any]) -> None:
+        name = rec.get("event")
+        if not name:
+            return
+        self.events += 1
+        tid = rec.get("trace_id")
+        if name in _JOB_LIFECYCLE_EVENTS and isinstance(tid, str) and tid:
+            # both halves of a job journal under the same names — count
+            # each (event, trace) once or in_flight goes negative
+            key = (name, tid)
+            if key not in self._seen_job_keys:
+                self._seen_job_keys.add(key)
+                self.counts[name] = self.counts.get(name, 0) + 1
+        else:
+            self.counts[name] = self.counts.get(name, 0) + 1
+        w = rec.get("worker") or rec.get("worker_id")
+        if w:
+            self.workers.add(str(w))
+        self.last_name = name
+        tw = rec.get("t_wall")
+        if isinstance(tw, (int, float)):
+            self.last_t_wall = float(tw)
+
+    def line(self) -> str:
+        c = self.counts
+        submitted = c.get(E.JOB_SUBMITTED, 0)
+        finished = c.get(E.JOB_FINISHED, 0)
+        failed = c.get(E.JOB_FAILED, 0)
+        in_flight = max(submitted - finished - failed, 0)
+        if self.last_t_wall is not None:
+            age = max(time.time() - self.last_t_wall, 0.0)
+            last = f"{self.last_name} {age:.1f}s ago"
+        else:
+            last = "-"
+        return (
+            f"events={self.events} submitted={submitted} finished={finished} "
+            f"failed={failed} in_flight={in_flight} "
+            f"workers={len(self.workers)} last={last}"
+        )
+
+
+def watch_journal(
+    path: str,
+    interval: float = 2.0,
+    ticks: Optional[int] = None,
+    stream: Optional[TextIO] = None,
+) -> int:
+    """Tail a live journal, printing one status line per tick.
+
+    ``ticks=None`` runs until interrupted (the CLI mode); tests pass a
+    finite count. Tolerates a journal that does not exist yet (a run
+    about to start) and follows through rotation (file shrank -> reopen
+    from the top). Partial trailing lines are buffered, never mis-parsed.
+    """
+    out = stream if stream is not None else sys.stdout
+    state = _WatchState()
+    pos = 0
+    buf = ""
+    tick = 0
+    while True:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = None
+        if size is not None:
+            if size < pos:  # rotated under us: the live file restarted
+                pos, buf = 0, ""
+            with open(path, "r", encoding="utf-8") as fh:
+                fh.seek(pos)
+                buf += fh.read()
+                pos = fh.tell()
+            lines = buf.split("\n")
+            buf = lines.pop()  # tail w/o newline: kept for the next tick
+            for line in lines:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    state.update(json.loads(line))
+                except ValueError:
+                    continue
+            status = state.line()
+        else:
+            status = f"(waiting for {path})"
+        stamp = time.strftime("%H:%M:%S")
+        print(f"[{stamp}] {status}", file=out, flush=True)
+        tick += 1
+        if ticks is not None and tick >= ticks:
+            return 0
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:  # graftlint: disable=swallowed-exception — ^C is the intended way to leave watch
+            return 0

@@ -1,0 +1,128 @@
+"""Trace context — one identity per job, carried across every hop.
+
+A distributed BOHB run is a relay: the master mints a job, the dispatcher
+RPCs it to a worker on another host, the worker computes and RPCs the
+result back. Each process journals its own half of the story; without a
+shared identity those halves can never be re-joined. :class:`TraceContext`
+is that identity: a ``run_id`` (the sweep), a ``trace_id`` (one job's
+round-trip), and a ``hop`` counter (how many process boundaries the
+context has crossed).
+
+Plumbing rules:
+
+* the *current* trace lives in a :mod:`contextvars` ContextVar — emitting
+  sites never pass ``trace_id`` by hand (the ``obs-reserved-fields``
+  graftlint rule forbids it); :func:`hpbandster_tpu.obs.events.make_event`
+  stamps it onto every event automatically;
+* across RPC it rides as an optional ``_obs`` envelope field beside
+  ``method``/``params`` (``parallel/rpc.py`` injects via
+  :func:`current_wire` and extracts via :func:`extract_wire`). Old peers
+  ignore the unknown key, so the wire stays backward compatible in both
+  directions;
+* threads do NOT inherit contextvars — code that hands work to another
+  thread (``Worker._rpc_start_computation`` -> compute thread) must
+  capture :func:`current_trace` and re-enter it with :func:`use_trace`.
+
+Stdlib-only, like the rest of ``obs``: importing this module pulls in no
+jax/numpy and a no-trace :func:`current_wire` is one ContextVar read.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+import uuid
+from typing import Any, Dict, Iterator, Optional
+
+__all__ = [
+    "TraceContext",
+    "WIRE_FIELD",
+    "new_trace",
+    "current_trace",
+    "set_trace",
+    "reset_trace",
+    "use_trace",
+    "current_wire",
+    "extract_wire",
+]
+
+#: the envelope key trace context travels under in RPC messages
+WIRE_FIELD = "_obs"
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """One job's identity: which run, which job, how many hops so far."""
+
+    run_id: str
+    trace_id: str
+    hop: int = 0
+
+
+_CURRENT: contextvars.ContextVar[Optional[TraceContext]] = contextvars.ContextVar(
+    "hpbandster_tpu_obs_trace", default=None
+)
+
+
+def new_trace(run_id: str = "") -> TraceContext:
+    """Mint a fresh trace identity (the master does this per job)."""
+    return TraceContext(run_id=str(run_id), trace_id=uuid.uuid4().hex[:16], hop=0)
+
+
+def current_trace() -> Optional[TraceContext]:
+    """The trace active in this thread/context, or None."""
+    return _CURRENT.get()
+
+
+def set_trace(ctx: Optional[TraceContext]) -> contextvars.Token:
+    """Make ``ctx`` current; returns the token for :func:`reset_trace`."""
+    return _CURRENT.set(ctx)
+
+
+def reset_trace(token: contextvars.Token) -> None:
+    _CURRENT.reset(token)
+
+
+@contextlib.contextmanager
+def use_trace(ctx: Optional[TraceContext]) -> Iterator[Optional[TraceContext]]:
+    """Run the body under ``ctx``. ``use_trace(None)`` is a no-op passthrough
+    (callers never need to branch on 'do I have a trace?')."""
+    if ctx is None:
+        yield None
+        return
+    token = _CURRENT.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _CURRENT.reset(token)
+
+
+# ------------------------------------------------------------------- wire
+def current_wire() -> Optional[Dict[str, Any]]:
+    """The ``_obs`` envelope for an outgoing RPC: the current trace with
+    its hop count advanced, or None when no trace is active (the common
+    case — one ContextVar read, no allocation)."""
+    ctx = _CURRENT.get()
+    if ctx is None:
+        return None
+    return {"run_id": ctx.run_id, "trace_id": ctx.trace_id, "hop": ctx.hop + 1}
+
+
+def extract_wire(wire: Any) -> Optional[TraceContext]:
+    """Parse an incoming ``_obs`` envelope into a :class:`TraceContext`.
+
+    Tolerant by contract: a missing, malformed, or future-shaped envelope
+    yields None — a telemetry field must never fail an RPC."""
+    if not isinstance(wire, dict):
+        return None
+    trace_id = wire.get("trace_id")
+    if not isinstance(trace_id, str) or not trace_id:
+        return None
+    run_id = wire.get("run_id")
+    hop = wire.get("hop")
+    return TraceContext(
+        run_id=run_id if isinstance(run_id, str) else "",
+        trace_id=trace_id,
+        hop=hop if isinstance(hop, int) and hop >= 0 else 0,
+    )

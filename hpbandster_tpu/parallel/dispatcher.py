@@ -9,16 +9,26 @@ semantics (SURVEY.md §5 "Failure detection" row).
 
 Implements the same executor seam as ``parallel.BatchedExecutor``, so the
 identical Master drives either tier.
+
+Observability (docs/observability.md): jobs are dispatched under their
+:class:`~hpbandster_tpu.obs.trace.TraceContext` (the ``_obs`` RPC envelope
+carries it to the worker), ``job_started`` reports ``queue_wait_s`` /
+``dispatch_s``, queue-depth and in-flight gauges track scheduling
+pressure, the ping loop doubles as the fleet heartbeat collector
+(``obs_snapshot`` per worker, ``dispatcher.workers_alive`` / last-seen-age
+gauges), and the dispatcher's own RPC server answers ``obs_snapshot``.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 from hpbandster_tpu import obs
 from hpbandster_tpu.core.job import Job
+from hpbandster_tpu.obs.health import HealthEndpoint
 from hpbandster_tpu.obs.journal import RingBuffer
 from hpbandster_tpu.parallel.rpc import (
     CommunicationError,
@@ -39,13 +49,38 @@ class WorkerProxy:
         self.uri = uri
         self.proxy = RPCProxy(uri, timeout=30)
         self.runs_job: Optional[Any] = None  # config_id or None
+        #: heartbeat state (written only by the ping loop / discovery)
+        self.last_seen_mono: float = time.monotonic()
+        self.last_snapshot: Optional[Dict[str, Any]] = None
+        self._supports_obs_snapshot = True  # optimistic until an RPCError
 
     def is_alive(self) -> bool:
         try:
             self.proxy.call("ping")
-            return True
         except (CommunicationError, RPCError):
             return False
+        self.last_seen_mono = time.monotonic()
+        return True
+
+    def heartbeat(self) -> bool:
+        """One liveness probe, preferring the ``obs_snapshot`` fleet-health
+        endpoint (worker metrics + ring tail + in-flight job retained on
+        :attr:`last_snapshot`); falls back to plain ``ping`` for older
+        peers that predate the endpoint."""
+        try:
+            if self._supports_obs_snapshot:
+                try:
+                    self.last_snapshot = self.proxy.call("obs_snapshot")
+                except RPCError:
+                    # older worker without the endpoint: remember, fall back
+                    self._supports_obs_snapshot = False
+                    self.proxy.call("ping")
+            else:
+                self.proxy.call("ping")
+        except (CommunicationError, RPCError):
+            return False
+        self.last_seen_mono = time.monotonic()
+        return True
 
     def shutdown(self) -> None:
         try:
@@ -101,6 +136,13 @@ class Dispatcher:
         self._server = RPCServer(self.host, 0)
         self._server.register("register_result", self._rpc_register_result)
         self._server.register("ping", lambda: "pong")
+        # fleet health: the dispatcher introspects like any other process
+        HealthEndpoint(
+            component="dispatcher",
+            identity=obs.process_identity(run_id=self.run_id),
+            ring=self.dead_letters,
+            in_flight=self._health_in_flight,
+        ).register(self._server)
         self._server.start()
 
         for target, name in (
@@ -117,7 +159,24 @@ class Dispatcher:
     def submit_job(self, job: Job) -> None:
         with self._cond:
             self.waiting_jobs.append(job)
+            self._update_queue_gauges()
             self._cond.notify_all()
+
+    def _update_queue_gauges(self) -> None:
+        # callers hold self._cond; the gauges' own registry lock nests
+        # inside it (metrics code never takes dispatcher locks, so the
+        # ordering is acyclic)
+        m = obs.get_metrics()
+        m.gauge("dispatcher.queue_depth").set(len(self.waiting_jobs))  # graftlint: disable=lock-coverage — every caller holds self._cond
+        m.gauge("dispatcher.jobs_in_flight").set(len(self.running_jobs))  # graftlint: disable=lock-coverage — every caller holds self._cond
+
+    def _health_in_flight(self) -> Dict[str, Any]:
+        with self._cond:
+            return {
+                "running": [list(cid) for cid in self.running_jobs],
+                "waiting": len(self.waiting_jobs),
+                "workers": len(self.workers),
+            }
 
     def number_of_workers(self) -> int:
         with self._cond:
@@ -192,6 +251,7 @@ class Dispatcher:
                     "worker %s vanished (%s); requeueing job %s", name, reason, job.id
                 )
                 self.waiting_jobs.insert(0, job)
+                self._update_queue_gauges()
             else:
                 self.logger.info("worker %s dropped (%s)", name, reason)
             self._cond.notify_all()
@@ -201,17 +261,39 @@ class Dispatcher:
             requeued=list(job.id) if job is not None else None,
         )
         obs.get_metrics().counter("dispatcher.workers_dropped").inc()
+        # a departed worker's last-seen-age gauge must leave with it, or
+        # elastic churn leaks stale frozen metrics without bound
+        obs.get_metrics().remove(f"dispatcher.worker_last_seen_age_s.{name}")
 
     def _ping_loop(self) -> None:
-        """Detect workers dying mid-job (requeue their jobs)."""
+        """Heartbeat collector: detect dying workers (requeue their jobs)
+        and keep the fleet-health gauges current."""
         while not self._shutdown_event.wait(self.ping_interval):
-            with self._cond:
-                busy = [
-                    (name, w) for name, w in self.workers.items() if w.runs_job
-                ]
-            for name, w in busy:
-                if not w.is_alive():
-                    self._drop_worker(name, reason="ping failed")
+            self._heartbeat_round()
+
+    def _heartbeat_round(self) -> None:
+        """One sweep over every known worker: ``obs_snapshot`` (or ``ping``
+        for older peers) each one, drop the unreachable — a dead idle
+        worker must leave the pool, not just a dead busy one — and feed
+        the ``dispatcher.workers_alive`` / per-worker last-seen-age
+        gauges."""
+        with self._cond:
+            targets = list(self.workers.items())
+        alive = 0
+        for name, w in targets:
+            if w.heartbeat():
+                alive += 1
+            else:
+                self._drop_worker(name, reason="heartbeat failed")
+        m = obs.get_metrics()
+        m.gauge("dispatcher.workers_alive").set(alive)
+        now = time.monotonic()
+        with self._cond:
+            survivors = list(self.workers.values())
+        for w in survivors:
+            m.gauge(f"dispatcher.worker_last_seen_age_s.{w.name}").set(
+                round(now - w.last_seen_mono, 3)
+            )
 
     # ------------------------------------------------------------ job runner
     def _idle_worker(self) -> Optional[WorkerProxy]:
@@ -232,6 +314,7 @@ class Dispatcher:
                         job = self.waiting_jobs.pop(0)
                         worker.runs_job = job.id
                         self.running_jobs[tuple(job.id)] = job
+                        self._update_queue_gauges()
                 if job is None:
                     self._cond.wait(0.2)
                     continue
@@ -239,16 +322,27 @@ class Dispatcher:
             # returns immediately
             job.time_it("started")
             job.worker_name = worker.name
+            queue_wait = job.mono_duration("submitted", "started")
             try:
-                worker.proxy.call(
-                    "start_computation",
-                    callback_uri=self._server.uri,
-                    id=list(job.id),
-                    **job.kwargs,
-                )
-                obs.emit(
-                    obs.JOB_STARTED, config_id=list(job.id), worker=worker.name
-                )
+                # under the job's trace: the RPC proxy injects the _obs
+                # envelope, so the worker's half of the timeline carries
+                # the same trace_id
+                with obs.use_trace(getattr(job, "trace", None)):
+                    t0 = time.monotonic()
+                    worker.proxy.call(
+                        "start_computation",
+                        callback_uri=self._server.uri,
+                        id=list(job.id),
+                        **job.kwargs,
+                    )
+                    obs.emit(
+                        obs.JOB_STARTED,
+                        config_id=list(job.id), worker=worker.name,
+                        queue_wait_s=(
+                            round(queue_wait, 6) if queue_wait is not None else None
+                        ),
+                        dispatch_s=round(time.monotonic() - t0, 6),
+                    )
                 self.logger.debug("job %s -> %s", job.id, worker.name)
             except (CommunicationError, RPCError) as e:
                 self.logger.warning(
@@ -261,6 +355,7 @@ class Dispatcher:
                     self._drop_worker(worker.name, reason="dispatch failed")
                 with self._cond:
                     self.waiting_jobs.insert(0, job)
+                    self._update_queue_gauges()
                     self._cond.notify_all()
 
     # ---------------------------------------------------------- result inflow
@@ -272,6 +367,7 @@ class Dispatcher:
                 for w in self.workers.values():
                     if w.runs_job is not None and tuple(w.runs_job) == cid:
                         w.runs_job = None
+                self._update_queue_gauges()
                 self._cond.notify_all()
         if job is None:
             # dead-letter, don't drop: a worker computed this (e.g. a late
@@ -279,8 +375,14 @@ class Dispatcher:
             # and re-discovered) — count it and retain the payload for
             # post-mortems instead of losing data silently. Outside the
             # lock: sinks do I/O, and a journal write must not stall the
-            # job-runner loop on self._cond.
-            self.dead_letters.append({"config_id": list(cid), "result": result})
+            # job-runner loop on self._cond. The delivering worker's trace
+            # (the _obs envelope on this very RPC) is retained with it, so
+            # the dead letter joins back onto the merged timeline.
+            tc = obs.current_trace()
+            self.dead_letters.append({
+                "config_id": list(cid), "result": result,
+                "trace_id": tc.trace_id if tc is not None else None,
+            })
             obs.get_metrics().counter("dispatcher.unknown_results").inc()
             obs.emit(obs.UNKNOWN_RESULT, config_id=list(cid))
             self.logger.warning(

@@ -7,6 +7,12 @@ Connection-per-call keeps liveness detection trivial (a vanished peer is a
 ``ConnectionError``), which the dispatcher's elastic worker handling relies
 on — the same failure surface Pyro4's ``CommunicationError`` gave the
 reference.
+
+Trace context (``hpbandster_tpu.obs.trace``) rides every call as an
+optional ``_obs`` field beside ``method``/``params``: the proxy injects
+the caller's current trace, the server runs the handler under it. Peers
+that predate the field ignore it (``msg.get``-based parsing), so the wire
+format stays backward compatible in both directions.
 """
 
 from __future__ import annotations
@@ -20,6 +26,7 @@ import traceback
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from hpbandster_tpu.obs import get_metrics
+from hpbandster_tpu.obs.trace import WIRE_FIELD, current_wire, extract_wire, use_trace
 
 __all__ = ["RPCServer", "RPCProxy", "RPCError", "CommunicationError", "parse_uri", "format_uri"]
 
@@ -72,7 +79,14 @@ def _read_frame(sock: socket.socket) -> Optional[bytes]:
     while True:
         chunk = sock.recv(65536)
         if not chunk:
-            return b"".join(chunks) if chunks else None
+            if chunks:
+                # the peer closed mid-frame: surface it as the transport
+                # failure it is, not as the json.JSONDecodeError the
+                # partial payload would later raise
+                raise CommunicationError(
+                    f"truncated frame: peer closed after {total} bytes"
+                )
+            return None
         chunks.append(chunk)
         total += len(chunk)
         if total > _MAX_FRAME:
@@ -91,16 +105,23 @@ class _Handler(socketserver.BaseRequestHandler):
             msg = json.loads(raw.decode("utf-8"))
             method = msg.get("method", "")
             params = msg.get("params", {})
+            _count("rpc.server_requests")
             fn = server.methods.get(method)
             if fn is None:
+                _count("rpc.server_unknown_method")
                 reply = {"error": f"unknown method {method!r}"}
             else:
                 try:
-                    reply = {"result": fn(**params)}
+                    # run the handler under the caller's trace context (the
+                    # optional _obs envelope beside method/params); a missing
+                    # or malformed envelope is simply no trace
+                    with use_trace(extract_wire(msg.get(WIRE_FIELD))):
+                        reply = {"result": fn(**params)}
                 except Exception:
+                    _count("rpc.server_handler_errors")
                     reply = {"error": traceback.format_exc()}
             self.request.sendall(json.dumps(reply).encode("utf-8") + b"\n")
-        except (ConnectionError, OSError, json.JSONDecodeError) as e:
+        except (CommunicationError, ConnectionError, OSError, json.JSONDecodeError) as e:
             logger.debug("rpc handler error: %r", e)
 
 
@@ -169,12 +190,21 @@ class RPCProxy:
         self.timeout = timeout
 
     def call(self, method: str, **params: Any) -> Any:
-        payload = json.dumps({"method": method, "params": params}).encode("utf-8")
+        msg: Dict[str, Any] = {"method": method, "params": params}
+        wire = current_wire()  # one ContextVar read when no trace is active
+        if wire is not None:
+            msg[WIRE_FIELD] = wire
+        payload = json.dumps(msg).encode("utf-8")
         _count("rpc.client_calls")
         try:
             with socket.create_connection(self.addr, timeout=self.timeout) as sock:
                 sock.sendall(payload + b"\n")
                 raw = _read_frame(sock)
+        except CommunicationError:
+            # _read_frame's own failures (truncated / oversized frame) are
+            # communication errors too — count them like every other one
+            _count("rpc.client_comm_errors")
+            raise
         except (ConnectionError, OSError) as e:
             _count("rpc.client_comm_errors")
             raise CommunicationError(f"cannot reach {self.uri}: {e!r}") from e

@@ -1,0 +1,24 @@
+"""Known-bad: reserved journal fields as ad-hoc kwargs (obs-reserved-fields).
+
+Each flagged line is marked ``# BAD``. ``trace_id`` is stamped by the
+trace context, ``host``/``pid`` by the journal's identity static fields,
+``event``/``t_wall``/``t_mono`` by the serializer — a call-site copy
+collides with the stamp or fabricates provenance.
+"""
+
+from hpbandster_tpu import obs
+from hpbandster_tpu.obs import emit, span
+
+
+def log_result(cid):
+    obs.emit("job_finished", config_id=cid, trace_id="deadbeef")  # BAD
+    emit("job_started", host="tpu-vm-7")  # BAD
+
+
+def forged_clock(bus):
+    bus.emit("checkpoint_written", t_wall=0.0)  # BAD
+
+
+def timed_region():
+    with span("compute", pid=4242):  # BAD
+        pass

@@ -1,0 +1,32 @@
+"""Known-good twins for obs-reserved-fields: the sanctioned patterns.
+
+Trace ids come from entering a trace (``use_trace``); host/pid come from
+identity static fields (``configure(identity=...)`` / journal
+``static_fields``); ordinary field names stay unflagged, including on
+non-obs ``.emit`` APIs in modules that never import obs.
+"""
+
+from hpbandster_tpu import obs
+from hpbandster_tpu.obs import emit, span
+from hpbandster_tpu.obs.journal import JsonlJournal, process_identity
+
+
+def log_result(cid, trace_ctx):
+    # the stamp comes from the context, not a kwarg
+    with obs.use_trace(trace_ctx):
+        obs.emit("job_finished", config_id=cid, budget=9.0)
+        emit("job_started", worker="w0", queue_wait_s=0.01)
+
+
+def timed_region():
+    with span("compute", budget=3.0):
+        pass
+
+
+def configured_identity(path):
+    # host/pid enter records via static fields, once, at configure time
+    journal = JsonlJournal(path, static_fields=process_identity(worker_id="w0"))
+    handle = obs.configure(journal_path=path, identity={"worker_id": "w0"})
+    handle.close()
+    journal.close()
+    return journal

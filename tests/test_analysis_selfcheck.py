@@ -26,6 +26,7 @@ RULE_TO_BAD_FIXTURE = {
     "swallowed-exception": "exceptions_bad.py",
     "pytest-marker": "test_markers_bad.py",
     "obs-emit-in-jit": "obs_emit_bad.py",
+    "obs-reserved-fields": "obs_reserved_bad.py",
 }
 
 

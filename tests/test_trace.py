@@ -1,0 +1,788 @@
+"""Distributed tracing & fleet health — trace context over RPC, worker
+journals, merged timelines, delivery retry, crash forensics.
+
+The contracts pinned here are the ones docs/observability.md "Trace
+propagation" promises: one ``trace_id`` minted at the master survives the
+master -> dispatcher -> worker -> result round-trip over REAL sockets and
+lands in both processes' journals; ``summarize a.jsonl b.jsonl``
+reconstructs the per-job queue-wait/dispatch/compute/delivery breakdown
+from the merge; a failed ``register_result`` is retried (never silently
+stranding a computed result); a truncated RPC frame is a transport error,
+not a JSON parse error; and an unhandled exception leaves a forensic
+crash dump.
+"""
+
+import io
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from hpbandster_tpu import obs
+from hpbandster_tpu.obs.__main__ import main as obs_main
+from hpbandster_tpu.obs.summarize import (
+    read_merged,
+    trace_timelines,
+    watch_journal,
+)
+from hpbandster_tpu.obs.trace import TraceContext
+from hpbandster_tpu.parallel.rpc import (
+    CommunicationError,
+    RPCProxy,
+    RPCServer,
+)
+
+
+class TestTraceContext:
+    def test_new_traces_are_unique_and_default_is_none(self):
+        a, b = obs.new_trace("r"), obs.new_trace("r")
+        assert a.trace_id != b.trace_id
+        assert a.run_id == "r" and a.hop == 0
+        assert obs.current_trace() is None
+
+    def test_use_trace_nests_and_restores(self):
+        outer = obs.new_trace("outer")
+        with obs.use_trace(outer):
+            assert obs.current_trace() is outer
+            inner = obs.new_trace("inner")
+            with obs.use_trace(inner):
+                assert obs.current_trace() is inner
+            assert obs.current_trace() is outer
+        assert obs.current_trace() is None
+
+    def test_use_trace_none_is_passthrough(self):
+        outer = obs.new_trace("outer")
+        with obs.use_trace(outer):
+            # a None ctx must not clobber the ambient trace
+            with obs.use_trace(None):
+                assert obs.current_trace() is outer
+
+    def test_wire_roundtrip_advances_hop(self):
+        with obs.use_trace(TraceContext("r", "abc123", 2)):
+            wire = obs.current_wire()
+        assert wire == {"run_id": "r", "trace_id": "abc123", "hop": 3}
+        ctx = obs.extract_wire(wire)
+        assert ctx == TraceContext("r", "abc123", 3)
+
+    def test_no_trace_means_no_wire(self):
+        assert obs.current_wire() is None
+
+    def test_extract_tolerates_junk(self):
+        for junk in (None, "x", 42, [], {}, {"trace_id": 7},
+                     {"trace_id": ""}, {"run_id": "r"}):
+            assert obs.extract_wire(junk) is None
+        # future-shaped envelopes degrade gracefully, never raise
+        ctx = obs.extract_wire(
+            {"trace_id": "t", "hop": "many", "run_id": 9, "new_field": 1}
+        )
+        assert ctx == TraceContext("", "t", 0)
+
+    def test_events_are_stamped_with_current_trace(self):
+        bus = obs.EventBus()
+        seen = []
+        bus.subscribe(seen.append)
+        with obs.use_trace(TraceContext("r", "stamp01", 0)):
+            bus.emit("job_started", config_id=[0, 0, 1])
+        bus.emit("job_finished")
+        assert seen[0].fields["trace_id"] == "stamp01"
+        assert "trace_id" not in seen[1].fields
+
+
+class TestRPCTracePropagation:
+    def _server(self):
+        srv = RPCServer("127.0.0.1", 0)
+        srv.register(
+            "whoami",
+            lambda: (lambda tc: {
+                "trace_id": tc.trace_id if tc else None,
+                "hop": tc.hop if tc else None,
+            })(obs.current_trace()),
+        )
+        srv.start()
+        return srv
+
+    def test_trace_crosses_the_wire_and_hop_advances(self):
+        srv = self._server()
+        try:
+            proxy = RPCProxy(srv.uri)
+            with obs.use_trace(TraceContext("r", "wire0001", 0)):
+                reply = proxy.call("whoami")
+            assert reply == {"trace_id": "wire0001", "hop": 1}
+        finally:
+            srv.shutdown()
+
+    def test_no_trace_no_envelope(self):
+        srv = self._server()
+        try:
+            assert RPCProxy(srv.uri).call("whoami") == {
+                "trace_id": None, "hop": None
+            }
+        finally:
+            srv.shutdown()
+
+    def test_old_peer_message_without_envelope_still_served(self):
+        """A hand-rolled frame with only method/params (what a pre-trace
+        peer sends) is served normally — the envelope is optional."""
+        srv = self._server()
+        try:
+            with socket.create_connection(("127.0.0.1", srv.port), timeout=5) as s:
+                s.sendall(json.dumps({"method": "whoami", "params": {}}).encode() + b"\n")
+                raw = s.makefile("rb").readline()
+            assert json.loads(raw)["result"] == {"trace_id": None, "hop": None}
+        finally:
+            srv.shutdown()
+
+    def test_unknown_envelope_key_ignored_by_server(self):
+        srv = self._server()
+        try:
+            with socket.create_connection(("127.0.0.1", srv.port), timeout=5) as s:
+                msg = {"method": "whoami", "params": {}, "_obs": "not-a-dict",
+                       "_future": {"x": 1}}
+                s.sendall(json.dumps(msg).encode() + b"\n")
+                raw = s.makefile("rb").readline()
+            assert json.loads(raw)["result"] == {"trace_id": None, "hop": None}
+        finally:
+            srv.shutdown()
+
+
+class TestRPCTransportHardening:
+    def test_truncated_reply_is_communication_error(self):
+        """A peer closing mid-frame must surface as CommunicationError
+        ('truncated frame'), not a confusing json.JSONDecodeError."""
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(1)
+        port = lsock.getsockname()[1]
+
+        def half_reply():
+            conn, _ = lsock.accept()
+            conn.recv(65536)
+            conn.sendall(b'{"result": [1, 2')  # no trailing newline
+            conn.close()
+
+        t = threading.Thread(target=half_reply, daemon=True)
+        t.start()
+        before = obs.get_metrics().counter("rpc.client_comm_errors").value
+        try:
+            with pytest.raises(CommunicationError, match="truncated"):
+                RPCProxy(f"127.0.0.1:{port}", timeout=5).call("anything")
+        finally:
+            t.join(timeout=5)
+            lsock.close()
+        # truncation counts like every other client communication failure
+        assert (
+            obs.get_metrics().counter("rpc.client_comm_errors").value
+            == before + 1
+        )
+
+    def test_server_side_counters(self):
+        srv = RPCServer("127.0.0.1", 0)
+        srv.register("ok", lambda: 1)
+
+        def boom():
+            raise ValueError("kaboom")
+
+        srv.register("boom", boom)
+        srv.start()
+        m = obs.get_metrics()
+        try:
+            before = {
+                name: m.counter(f"rpc.server_{name}").value
+                for name in ("requests", "unknown_method", "handler_errors")
+            }
+            proxy = RPCProxy(srv.uri)
+            proxy.call("ok")
+            with pytest.raises(Exception):
+                proxy.call("nope")
+            with pytest.raises(Exception):
+                proxy.call("boom")
+            assert m.counter("rpc.server_requests").value == before["requests"] + 3
+            assert (
+                m.counter("rpc.server_unknown_method").value
+                == before["unknown_method"] + 1
+            )
+            assert (
+                m.counter("rpc.server_handler_errors").value
+                == before["handler_errors"] + 1
+            )
+        finally:
+            srv.shutdown()
+
+
+class _EchoWorker:
+    """Tiny Worker subclass factory used by the delivery tests."""
+
+    @staticmethod
+    def make(tmp_path, **kw):
+        from hpbandster_tpu.core.worker import Worker
+
+        class W(Worker):
+            def compute(self, config_id, config, budget, working_directory):
+                return {"loss": float(budget), "info": {}}
+
+        return W(run_id="deliver", nameserver="127.0.0.1", **kw)
+
+
+class TestWorkerResultDelivery:
+    def _flaky_sink(self, fail_first: int):
+        """An RPC server whose register_result fails the first N calls."""
+        state = {"calls": 0, "delivered": []}
+        srv = RPCServer("127.0.0.1", 0)
+
+        def register_result(id, result):
+            state["calls"] += 1
+            if state["calls"] <= fail_first:
+                raise RuntimeError(f"synthetic failure {state['calls']}")
+            state["delivered"].append((tuple(id), result))
+            return True
+
+        srv.register("register_result", register_result)
+        srv.start()
+        return srv, state
+
+    def test_delivery_retries_until_success(self, tmp_path):
+        journal_path = str(tmp_path / "worker.jsonl")
+        w = _EchoWorker.make(tmp_path, journal_path=journal_path)
+        w.result_delivery_backoff = 0.01
+        w.result_delivery_backoff_cap = 0.02
+        w._journal = obs.JsonlJournal(journal_path, static_fields=w.identity())
+        srv, state = self._flaky_sink(fail_first=2)
+        m = obs.get_metrics()
+        retries0 = m.counter("worker.result_delivery_retries").value
+        failures0 = m.counter("worker.result_delivery_failures").value
+        try:
+            w._busy_lock.acquire()
+            w._run_job(
+                srv.uri, (0, 0, 1),
+                {"config": {}, "budget": 3.0, "working_directory": "."},
+                TraceContext("deliver", "retry001", 1),
+            )
+        finally:
+            srv.shutdown()
+            w._journal.close()
+        assert [cid for cid, _ in state["delivered"]] == [(0, 0, 1)]
+        assert m.counter("worker.result_delivery_retries").value == retries0 + 2
+        assert m.counter("worker.result_delivery_failures").value == failures0
+
+        records = obs.read_journal(journal_path)
+        by_event = {}
+        for r in records:
+            by_event.setdefault(r["event"], []).append(r)
+        # the redelivery attempts are visible on the merged timeline...
+        assert len(by_event["rpc_retry"]) == 2
+        assert by_event["rpc_retry"][0]["attempt"] == 1
+        # ...and every record carries the propagated trace + identity stamp
+        for r in records:
+            assert r["trace_id"] == "retry001"
+            assert r["worker_id"] == w.worker_id
+            assert "host" in r and "pid" in r
+        delivered = by_event["result_delivered"][0]
+        assert delivered["attempts"] == 3
+        assert delivered["delivery_s"] > 0
+
+    def test_emit_failure_never_wedges_the_worker(self, tmp_path):
+        """A failing journal (disk full, closed file) must not leak the
+        busy lock or skip result delivery — telemetry never kills work."""
+        w = _EchoWorker.make(tmp_path)
+
+        class ExplodingJournal:
+            def __call__(self, ev):
+                raise OSError("disk full")
+
+        w._journal = ExplodingJournal()
+        srv, state = self._flaky_sink(fail_first=0)
+        try:
+            w._busy_lock.acquire()
+            w._run_job(
+                srv.uri, (0, 0, 3),
+                {"config": {}, "budget": 1.0, "working_directory": "."},
+            )
+        finally:
+            w._journal = None
+            srv.shutdown()
+        # the result still arrived and the worker is idle again
+        assert [cid for cid, _ in state["delivered"]] == [(0, 0, 3)]
+        assert not w._busy_lock.locked()
+        assert w._current_job is None
+
+    def test_unserializable_result_is_counted_not_thread_killing(self, tmp_path):
+        """A payload json can't encode must surface as a logged, counted
+        delivery failure (pre-retry behavior), not an uncaught exception
+        in the compute thread."""
+        w = _EchoWorker.make(tmp_path)
+        w.result_delivery_attempts = 2
+        w.result_delivery_backoff = 0.01
+        srv, _ = self._flaky_sink(fail_first=0)
+        m = obs.get_metrics()
+        failures0 = m.counter("worker.result_delivery_failures").value
+        try:
+            ok = w._deliver_result(
+                srv.uri, (0, 0, 4), {"result": {"loss": object()}}
+            )
+        finally:
+            srv.shutdown()
+        assert ok is False
+        assert (
+            m.counter("worker.result_delivery_failures").value == failures0 + 1
+        )
+
+    def test_delivery_gives_up_after_capped_attempts(self, tmp_path):
+        w = _EchoWorker.make(tmp_path)
+        w.result_delivery_attempts = 2
+        w.result_delivery_backoff = 0.01
+        srv, state = self._flaky_sink(fail_first=99)
+        m = obs.get_metrics()
+        failures0 = m.counter("worker.result_delivery_failures").value
+        try:
+            assert w._deliver_result(srv.uri, (0, 0, 2), {"result": None}) is False
+        finally:
+            srv.shutdown()
+        assert state["calls"] == 2
+        assert m.counter("worker.result_delivery_failures").value == failures0 + 1
+
+
+class TestDispatcherTelemetry:
+    def test_queue_gauges_track_submit_and_result(self):
+        from hpbandster_tpu.core.job import Job
+        from hpbandster_tpu.parallel.dispatcher import Dispatcher
+
+        d = Dispatcher(run_id="gauges")
+        d._new_result_callback = lambda job: None
+        m = obs.get_metrics()
+
+        job = Job((0, 0, 9), budget=1.0, config={})
+        job.time_it("submitted")
+        d.submit_job(job)
+        assert m.gauge("dispatcher.queue_depth").value == 1
+        # simulate the runner assigning it
+        with d._cond:
+            d.waiting_jobs.pop(0)
+            d.running_jobs[(0, 0, 9)] = job
+            d._update_queue_gauges()
+        assert m.gauge("dispatcher.queue_depth").value == 0
+        assert m.gauge("dispatcher.jobs_in_flight").value == 1
+        assert d._rpc_register_result([0, 0, 9], {"result": {"loss": 1.0}})
+        assert m.gauge("dispatcher.jobs_in_flight").value == 0
+
+    def test_dead_letter_retains_trace_id(self):
+        from hpbandster_tpu.parallel.dispatcher import Dispatcher
+
+        d = Dispatcher(run_id="dl-trace")
+        # nobody is waiting for this id; the delivering worker's trace (as
+        # the RPC handler would have entered it) must ride the dead letter
+        with obs.use_trace(TraceContext("dl-trace", "dead0001", 2)):
+            assert d._rpc_register_result(
+                [9, 9, 9], {"result": {"loss": 0.1}, "exception": None}
+            ) is False
+        entry = d.dead_letters.snapshot()[-1]
+        assert entry["config_id"] == [9, 9, 9]
+        assert entry["trace_id"] == "dead0001"
+        assert entry["result"]["result"]["loss"] == 0.1
+
+    def test_dispatch_failure_requeue_keeps_trace(self):
+        """A worker that refuses start_computation loses the job back to
+        the queue — same Job object, same trace, so the eventual retry
+        continues the SAME story on the timeline."""
+        from hpbandster_tpu.core.job import Job
+        from hpbandster_tpu.parallel.dispatcher import Dispatcher, WorkerProxy
+
+        srv = RPCServer("127.0.0.1", 0)
+
+        def refuse(**kw):
+            raise RuntimeError("worker is busy")
+
+        srv.register("start_computation", refuse)
+        srv.register("ping", lambda: "pong")
+        srv.start()
+        d = Dispatcher(run_id="requeue")
+        d._new_result_callback = lambda job: None
+        d._new_worker_callback = lambda n: None
+        d._server = RPCServer("127.0.0.1", 0)
+        d._server.start()
+        try:
+            w = WorkerProxy("w0", srv.uri)
+            with d._cond:
+                d.workers["w0"] = w
+            job = Job((1, 0, 0), budget=1.0, config={},
+                      working_directory=".")
+            job.trace = obs.new_trace("requeue")
+            job.time_it("submitted")
+            d.submit_job(job)
+            # drive one runner iteration inline (no background threads)
+            runner = threading.Thread(target=d._job_runner_loop, daemon=True)
+            runner.start()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                with d._cond:
+                    requeued = d.waiting_jobs and d.waiting_jobs[0] is job
+                    idle_again = w.runs_job is None
+                if requeued and idle_again and job.worker_name == "w0":
+                    break
+                time.sleep(0.01)
+            d._shutdown_event.set()
+            runner.join(timeout=5)
+            with d._cond:
+                assert d.waiting_jobs[0] is job
+                assert not d.running_jobs
+            assert job.trace is not None  # same trace for the retry
+        finally:
+            d.shutdown()
+            srv.shutdown()
+
+    def test_heartbeat_round_collects_snapshots_and_gauges(self, tmp_path):
+        """The ping loop is a heartbeat collector: obs_snapshot from a real
+        worker server feeds workers_alive + last-seen-age gauges, and the
+        snapshot payload (identity, uptime, metrics) is retained."""
+        from hpbandster_tpu.parallel.dispatcher import Dispatcher, WorkerProxy
+
+        w = _EchoWorker.make(tmp_path, nameserver_port=1)  # never run()
+        srv = RPCServer("127.0.0.1", 0)
+        srv.register("ping", lambda: "pong")
+        obs.HealthEndpoint(
+            component="worker", identity=w.identity(), ring=w._ring,
+            in_flight=lambda: None,
+        ).register(srv)
+        srv.start()
+        d = Dispatcher(run_id="hb")
+        try:
+            with d._cond:
+                d.workers["w0"] = WorkerProxy("w0", srv.uri)
+            d._heartbeat_round()
+            m = obs.get_metrics()
+            assert m.gauge("dispatcher.workers_alive").value == 1
+            age = m.gauge("dispatcher.worker_last_seen_age_s.w0").value
+            assert 0 <= age < 5
+            snap = d.workers["w0"].last_snapshot
+            assert snap["component"] == "worker"
+            assert snap["identity"]["worker_id"] == w.worker_id
+            assert snap["uptime_s"] >= 0
+            assert "counters" in snap["metrics"]
+        finally:
+            srv.shutdown()
+
+    def test_dropped_worker_gauge_is_removed(self):
+        """Elastic churn must not leak per-worker gauges: dropping a
+        worker removes its last-seen-age gauge from the registry."""
+        from hpbandster_tpu.parallel.dispatcher import Dispatcher, WorkerProxy
+
+        d = Dispatcher(run_id="gauge-gc")
+        d._new_worker_callback = lambda n: None
+        m = obs.get_metrics()
+        with d._cond:
+            d.workers["ghost"] = WorkerProxy("ghost", "127.0.0.1:1")
+        m.gauge("dispatcher.worker_last_seen_age_s.ghost").set(0.1)
+        d._drop_worker("ghost", reason="test")
+        assert "dispatcher.worker_last_seen_age_s.ghost" not in (
+            m.snapshot()["gauges"]
+        )
+        assert m.remove("definitely-not-there") is False
+
+    def test_heartbeat_falls_back_to_ping_for_old_workers(self):
+        from hpbandster_tpu.parallel.dispatcher import WorkerProxy
+
+        srv = RPCServer("127.0.0.1", 0)  # ping only — a pre-health peer
+        srv.register("ping", lambda: "pong")
+        srv.start()
+        try:
+            w = WorkerProxy("old", srv.uri)
+            assert w.heartbeat() is True  # RPCError absorbed, ping fallback
+            assert w.last_snapshot is None
+            assert w.heartbeat() is True  # second round goes straight to ping
+        finally:
+            srv.shutdown()
+
+
+class TestMergedTimelines:
+    def _records(self):
+        # synthetic two-journal story: master/dispatcher side + worker side
+        t = 1000.0
+        return [
+            {"event": "job_submitted", "t_wall": t, "t_mono": 1.0,
+             "config_id": [0, 0, 0], "trace_id": "tr1", "host": "master"},
+            {"event": "job_started", "t_wall": t + 1, "t_mono": 2.0,
+             "config_id": [0, 0, 0], "trace_id": "tr1", "host": "master",
+             "worker": "w0", "queue_wait_s": 1.0, "dispatch_s": 0.2},
+            {"event": "job_started", "t_wall": t + 1.2, "t_mono": 9.0,
+             "config_id": [0, 0, 0], "trace_id": "tr1", "host": "tpu-vm"},
+            {"event": "job_finished", "t_wall": t + 3.2, "t_mono": 11.0,
+             "config_id": [0, 0, 0], "trace_id": "tr1", "host": "tpu-vm",
+             "compute_s": 2.0},
+            {"event": "rpc_retry", "t_wall": t + 3.3, "t_mono": 11.1,
+             "config_id": [0, 0, 0], "trace_id": "tr1", "host": "tpu-vm",
+             "attempt": 1},
+            {"event": "result_delivered", "t_wall": t + 3.4, "t_mono": 11.2,
+             "config_id": [0, 0, 0], "trace_id": "tr1", "host": "tpu-vm",
+             "delivery_s": 0.2},
+            {"event": "job_finished", "t_wall": t + 3.5, "t_mono": 4.5,
+             "config_id": [0, 0, 0], "trace_id": "tr1", "host": "master",
+             "worker": "w0", "queue_s": 1.0, "run_s": 2.5},
+            # a second, failed trace with no worker-side records
+            {"event": "job_submitted", "t_wall": t + 5, "t_mono": 6.0,
+             "config_id": [0, 0, 1], "trace_id": "tr2", "host": "master"},
+            {"event": "job_failed", "t_wall": t + 6, "t_mono": 7.0,
+             "config_id": [0, 0, 1], "trace_id": "tr2", "host": "master",
+             "run_s": 0.5},
+            {"event": "kde_refit", "t_wall": t + 7, "t_mono": 8.0,
+             "duration_s": 0.1},  # traceless: ignored by timelines
+        ]
+
+    def test_stage_breakdown_joined_across_hosts(self):
+        tl = trace_timelines(self._records())
+        assert tl["count"] == 2
+        tr1 = tl["timelines"][0]
+        assert tr1["trace_id"] == "tr1"
+        assert tr1["hosts"] == ["master", "tpu-vm"]
+        assert tr1["stages"] == {
+            "queue_wait_s": 1.0, "dispatch_s": 0.2, "compute_s": 2.0,
+            "delivery_s": 0.2, "end_to_end_s": 2.5,
+        }
+        assert tr1["retries"] == 1 and not tr1["failed"]
+        tr2 = tl["timelines"][1]
+        assert tr2["failed"] and tr2["stages"] == {"end_to_end_s": 0.5}
+        assert tl["stage_latency_s"]["compute_s"]["count"] == 1
+
+    def test_merge_orders_by_wall_clock(self, tmp_path):
+        a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+        recs = self._records()
+        with open(a, "w") as fh:
+            for r in recs[1::2]:
+                fh.write(json.dumps(r) + "\n")
+        with open(b, "w") as fh:
+            for r in recs[0::2]:
+                fh.write(json.dumps(r) + "\n")
+        merged = read_merged([a, b])
+        assert [r["t_wall"] for r in merged] == sorted(
+            r["t_wall"] for r in recs
+        )
+
+    def test_merged_job_counts_deduplicate_on_trace(self):
+        """Master and worker both journal job_finished/job_failed for the
+        same job; a merged summary must count each job ONCE (and the
+        failure tally with it), while still folding both sides' fields
+        into the stage aggregates."""
+        from hpbandster_tpu.obs.summarize import summarize_records
+
+        s = summarize_records(self._records())
+        assert s["event_counts"]["job_finished"] == 1  # tr1, both halves
+        assert s["event_counts"]["job_failed"] == 1
+        assert s["failures"]["jobs_failed"] == 1
+        # both halves' durations still contributed
+        assert s["stage_latency_s"]["run"]["count"] == 2  # tr1 + tr2 run_s
+        assert s["traces"]["timelines"][0]["stages"]["compute_s"] == 2.0
+
+    def test_cli_merges_and_prints_breakdown(self, tmp_path, capsys):
+        a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+        recs = self._records()
+        with open(a, "w") as fh:
+            for r in recs:
+                if r.get("host") != "tpu-vm":
+                    fh.write(json.dumps(r) + "\n")
+        with open(b, "w") as fh:
+            for r in recs:
+                if r.get("host") == "tpu-vm":
+                    fh.write(json.dumps(r) + "\n")
+        assert obs_main(["summarize", a, b]) == 0
+        out = capsys.readouterr().out
+        assert "trace timelines (2 traces)" in out
+        for col in ("queue_wait", "dispatch", "compute", "delivery", "end_to_end"):
+            assert col in out
+        assert "master,tpu-vm" in out
+        assert obs_main(["summarize", a, b, "--json"]) == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["traces"]["count"] == 2
+        assert summary["traces"]["timelines"][0]["stages"]["compute_s"] == 2.0
+
+    def test_cli_missing_one_journal_is_usage_error(self, tmp_path, capsys):
+        a = str(tmp_path / "a.jsonl")
+        with open(a, "w") as fh:
+            fh.write("{}\n")
+        assert obs_main(["summarize", a, str(tmp_path / "nope.jsonl")]) == 2
+
+
+class TestIdentityStamping:
+    def test_static_fields_stamp_every_record_without_clobbering(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = obs.JsonlJournal(path, static_fields={"host": "h1", "pid": 7})
+        j.write_record({"event": "a"})
+        j.write_record({"event": "b", "host": "explicit-wins"})
+        j.close()
+        recs = obs.read_journal(path)
+        assert recs[0]["host"] == "h1" and recs[0]["pid"] == 7
+        assert recs[1]["host"] == "explicit-wins" and recs[1]["pid"] == 7
+
+    def test_configure_identity_true_and_dict(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        handle = obs.configure(
+            journal_path=path, identity={"worker_id": "w7"},
+        )
+        try:
+            obs.emit("job_submitted", config_id=[0, 0, 0])
+        finally:
+            handle.close()
+        rec = obs.read_journal(path)[0]
+        ident = obs.process_identity()
+        assert rec["host"] == ident["host"] and rec["pid"] == ident["pid"]
+        assert rec["worker_id"] == "w7"
+
+
+class TestWatch:
+    def test_watch_renders_counts_and_survives_missing_file(self, tmp_path):
+        path = str(tmp_path / "live.jsonl")
+        out = io.StringIO()
+        assert watch_journal(path, interval=0.01, ticks=1, stream=out) == 0
+        assert "waiting for" in out.getvalue()
+
+        now = time.time()
+        with open(path, "w") as fh:
+            for i in range(3):
+                fh.write(json.dumps({
+                    "event": "job_submitted", "t_wall": now, "config_id": [0, 0, i],
+                }) + "\n")
+            fh.write(json.dumps({
+                "event": "job_finished", "t_wall": now, "worker": "w0",
+            }) + "\n")
+            fh.write('{"event": "job_failed"')  # torn final line: buffered
+        out = io.StringIO()
+        assert watch_journal(path, interval=0.01, ticks=1, stream=out) == 0
+        line = out.getvalue().strip()
+        assert "submitted=3" in line
+        assert "finished=1" in line
+        assert "in_flight=2" in line
+        assert "workers=1" in line
+        assert "last=job_finished" in line
+
+    def test_cli_watch_ticks(self, tmp_path, capsys):
+        path = str(tmp_path / "live.jsonl")
+        with open(path, "w") as fh:
+            fh.write(json.dumps({"event": "job_submitted", "t_wall": 1.0}) + "\n")
+        assert obs_main(["watch", path, "--ticks", "2", "--interval", "0.01"]) == 0
+        lines = [l for l in capsys.readouterr().out.splitlines() if l]
+        assert len(lines) == 2 and "submitted=1" in lines[0]
+
+
+class TestCrashDump:
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+    )
+    def test_thread_crash_leaves_forensic_record(self, tmp_path):
+        path = str(tmp_path / "crash.json")
+        ring = obs.RingBuffer(capacity=8)
+        ring.append({"event": "job_started", "t_wall": 1.0})
+        reg = obs.MetricsRegistry()
+        reg.counter("jobs").inc(3)
+        uninstall = obs.install_crash_dump(
+            path, component="worker", ring=ring, registry=reg
+        )
+        try:
+            def boom():
+                raise RuntimeError("synthetic crash")
+
+            t = threading.Thread(target=boom, name="doomed")
+            t.start()
+            t.join(timeout=5)
+        finally:
+            uninstall()
+            uninstall()  # idempotent
+        with open(path) as fh:
+            dump = json.load(fh)
+        assert dump["component"] == "worker"
+        assert dump["thread"] == "doomed"
+        assert dump["exception"]["type"] == "RuntimeError"
+        assert "synthetic crash" in dump["exception"]["traceback"]
+        assert dump["metrics"]["counters"]["jobs"] == 3
+        assert dump["ring_tail"] == [{"event": "job_started", "t_wall": 1.0}]
+
+    def test_uninstall_restores_hooks(self):
+        import sys
+
+        prev_sys, prev_thr = sys.excepthook, threading.excepthook
+        uninstall = obs.install_crash_dump("/tmp/never-written.json")
+        assert sys.excepthook is not prev_sys
+        uninstall()
+        assert sys.excepthook is prev_sys
+        assert threading.excepthook is prev_thr
+
+
+class TestEndToEndDistributedTrace:
+    def test_one_trace_id_spans_master_and_worker_journals(self, tmp_path, capsys):
+        """Acceptance criterion: a real socket round-trip (nameserver +
+        dispatcher + worker) with two separate journals; every job's
+        trace_id appears in BOTH, and the merged summarize prints the
+        queue-wait/dispatch/compute/delivery breakdown."""
+        from hpbandster_tpu.core.nameserver import NameServer
+        from hpbandster_tpu.core.worker import Worker
+        from hpbandster_tpu.optimizers import BOHB
+
+        from tests.toys import branin_dict, branin_space
+
+        class BraninWorker(Worker):
+            def compute(self, config_id, config, budget, working_directory):
+                return {"loss": branin_dict(config, budget), "info": {}}
+
+        master_journal = str(tmp_path / "master.jsonl")
+        worker_journal = str(tmp_path / "worker.jsonl")
+        handle = obs.configure(
+            journal_path=master_journal, identity={"component": "master"}
+        )
+        ns = NameServer(run_id="trace-e2e", host="127.0.0.1", port=0)
+        host, port = ns.start()
+        try:
+            BraninWorker(
+                run_id="trace-e2e", nameserver=host, nameserver_port=port,
+                id=0, journal_path=worker_journal,
+            ).run(background=True)
+            opt = BOHB(
+                configspace=branin_space(seed=5), run_id="trace-e2e",
+                nameserver=host, nameserver_port=port,
+                min_budget=1, max_budget=9, eta=3, seed=5,
+            )
+            opt.run(n_iterations=1, min_n_workers=1)
+            opt.shutdown(shutdown_workers=True)
+        finally:
+            ns.shutdown()
+            handle.close()
+
+        master_recs = obs.read_journal(master_journal)
+        worker_recs = obs.read_journal(worker_journal)
+        master_traces = {
+            r["trace_id"] for r in master_recs
+            if r["event"] == "job_submitted"
+        }
+        worker_traces = {
+            r.get("trace_id") for r in worker_recs
+            if r["event"] == "job_finished"
+        }
+        assert master_traces, "master journal carries no submitted traces"
+        # every computed job's trace came from the master, over the wire
+        assert worker_traces <= master_traces
+        assert worker_traces, "worker journal carries no traces"
+        # worker journal is identity-stamped, record by record
+        for r in worker_recs:
+            assert "host" in r and "pid" in r and "worker_id" in r
+        # worker-side lifecycle is complete
+        worker_events = {r["event"] for r in worker_recs}
+        assert {"job_started", "job_finished", "result_delivered"} <= worker_events
+
+        assert obs_main(["summarize", master_journal, worker_journal]) == 0
+        out = capsys.readouterr().out
+        assert "trace timelines" in out
+        for col in ("queue_wait", "dispatch", "compute", "delivery", "end_to_end"):
+            assert col in out
+
+        assert obs_main([
+            "summarize", master_journal, worker_journal, "--json"
+        ]) == 0
+        summary = json.loads(capsys.readouterr().out)
+        finished = [
+            t for t in summary["traces"]["timelines"]
+            if t["trace_id"] in worker_traces
+        ]
+        assert finished
+        for t in finished:
+            # the full cross-process stage breakdown joined on trace_id
+            assert {
+                "queue_wait_s", "dispatch_s", "compute_s", "delivery_s",
+                "end_to_end_s",
+            } <= set(t["stages"])
