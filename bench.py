@@ -65,7 +65,7 @@ HEADLINE_BRACKETS = 27
 TIER_ORDER = (
     "cnn", "cnn_wide", "pallas", "resnet", "transformer", "fused10k",
     "chunked10k", "chunked_compile", "fused", "rpc", "batched", "teacher",
-    "obs_overhead",
+    "obs_overhead", "report_100k",
 )
 
 #: per-tier sample size after one warmup run (compile excluded). The driver
@@ -743,6 +743,15 @@ def bench_obs_overhead(repeats=3, n_iterations=3, inner=20, seed=0):
     for _ in range(n_micro):
         obs.current_wire()
     inject_ns = (time.perf_counter() - t0) / n_micro * 1e9
+    # audit-record emit with no sink — what every add_configuration pays
+    # per sample since the decision audit landed (the field-dict build is
+    # behind the bus.active check, so this must stay ~one boolean check)
+    t0 = time.perf_counter()
+    for _ in range(n_micro):
+        obs.emit_config_sampled(
+            (0, 0, 0), 1.0, {"model_based_pick": False, "sample_reason": "no_model"}
+        )
+    audit_ns = (time.perf_counter() - t0) / n_micro * 1e9
 
     # --- exact instrumented-call census of one sweep
     events = []
@@ -790,6 +799,7 @@ def bench_obs_overhead(repeats=3, n_iterations=3, inner=20, seed=0):
         "emit_no_sink_ns": round(emit_ns, 1),
         "counter_inc_ns": round(counter_ns, 1),
         "trace_inject_no_trace_ns": round(inject_ns, 1),
+        "audit_emit_ns": round(audit_ns, 1),
         "instrumented_calls_per_sweep": {"emits": n_emits, "counter_incs": n_incs},
         "warm_sweep_s": round(sweep_s, 5),
         "overhead_pct": round(100.0 * per_sweep_cost_s / sweep_s, 3)
@@ -803,6 +813,94 @@ def bench_obs_overhead(repeats=3, n_iterations=3, inner=20, seed=0):
             "note": "shared-host wall noise floor >> sub-percent effects; "
                     "cross-check only",
         },
+    }
+
+
+def bench_report_100k(n_events=100_000, seed=0):
+    """Report-CLI throughput over a synthetic ``n_events``-line journal.
+
+    Synthesizes a journal shaped like a real sweep's (config_sampled /
+    job_finished with losses / promotion_decision / kde_refit / worker
+    churn), then times the full ``report`` path: rotated-set read, merge,
+    ``build_report``, text render. Renders TWICE and compares bytes —
+    the determinism acceptance bar rides the bench, not just the tests.
+    Stdlib + obs only: measures on any backend, fallback runs included.
+    """
+    import random as _random
+    import tempfile
+
+    from hpbandster_tpu.obs.report import build_report, format_report
+    from hpbandster_tpu.obs.summarize import read_merged_ex
+
+    rng = _random.Random(seed)
+    t_wall = 1_700_000_000.0
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "synthetic.jsonl")
+        n = 0
+        t0 = time.perf_counter()
+        with open(path, "w", encoding="utf-8") as fh:
+            i = 0
+            while n < n_events:
+                cid = [i // 27, 0, i % 27]
+                t_wall += rng.random() * 0.01
+                model = i % 3 != 0
+                recs = [
+                    {"event": "config_sampled", "t_wall": t_wall,
+                     "t_mono": n * 1e-3, "config_id": cid, "budget": 1.0,
+                     "model_based_pick": model,
+                     "sample_reason": "model" if model else "random_fraction",
+                     "lg_score": round(rng.random() * 5, 6)},
+                    {"event": "job_finished", "t_wall": t_wall + 0.005,
+                     "t_mono": n * 1e-3 + 0.005, "config_id": cid,
+                     "budget": 1.0, "worker": f"w{i % 7}",
+                     "run_s": 0.004 + rng.random() * 0.002,
+                     "loss": round(rng.random() * 100, 6)},
+                ]
+                if i % 27 == 26:
+                    ids = [[i // 27, 0, k] for k in range(27)]
+                    recs.append({
+                        "event": "promotion_decision", "t_wall": t_wall,
+                        "t_mono": n * 1e-3, "iteration": i // 27, "rung": 0,
+                        "budget": 1.0, "next_budget": 3.0,
+                        "rule": "successive_halving",
+                        "config_ids": ids,
+                        "losses": [round(rng.random() * 100, 6)
+                                   for _ in ids],
+                        "promoted": [k < 9 for k in range(27)],
+                        "n_promoted": 9, "n_candidates": 27,
+                        "cut_threshold": 33.0,
+                        "survivor_losses": [1.0] * 9,
+                    })
+                if i % 100 == 99:
+                    recs.append({
+                        "event": "kde_refit", "t_wall": t_wall,
+                        "t_mono": n * 1e-3, "budget": 1.0,
+                        "n_obs": i, "duration_s": 0.001,
+                    })
+                for rec in recs:
+                    fh.write(json.dumps(rec) + "\n")
+                n += len(recs)
+                i += 1
+        synth_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        records, skipped = read_merged_ex([path])
+        read_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        rep = build_report(records)
+        text_a = format_report(rep)
+        report_s = time.perf_counter() - t0
+        text_b = format_report(build_report(records))
+    total_s = read_s + report_s
+    return {
+        "n_events": n,
+        "synthesize_s": round(synth_s, 3),
+        "read_merge_s": round(read_s, 3),
+        "build_render_s": round(report_s, 3),
+        "events_per_s": round(n / total_s) if total_s > 0 else None,
+        "skipped_lines": skipped,
+        "deterministic": text_a == text_b,
+        "alerts_found": rep["alerts"]["total"],
     }
 
 
@@ -921,6 +1019,8 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
                                           repeats=repeats))
         obs_overhead = emit("obs_overhead", _run_tier(
             errors, "obs_overhead", bench_obs_overhead, repeats=repeats))
+        report_100k = emit("report_100k", _run_tier(
+            errors, "report_100k", bench_report_100k, n_events=5_000))
     else:
         # evidence-value execution order (TIER_ORDER): the tiers that have
         # never produced a chip number run FIRST, so a driver timeout or a
@@ -1061,6 +1161,14 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
                  _run_tier(errors, "obs_overhead", bench_obs_overhead))
             if selected("obs_overhead") else dict(NOT_SELECTED)
         )
+        # backend-independent like obs_overhead: journal synthesis + the
+        # report pipeline are pure host work, so the throughput (and the
+        # byte-identical determinism check) measures on the fallback too
+        report_100k = (
+            emit("report_100k",
+                 _run_tier(errors, "report_100k", bench_report_100k))
+            if selected("report_100k") else dict(NOT_SELECTED)
+        )
 
     def median_of(tier):
         return tier.get("median") if isinstance(tier, dict) else None
@@ -1146,6 +1254,7 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
             "chunked_compile_static_vs_dynamic": chunked,
             "chunked10k_at_scale_36_brackets_1_729": chunked10k,
             "obs_overhead_no_sink": obs_overhead,
+            "report_100k_events": report_100k,
         },
     }
     if smoke:
@@ -1386,6 +1495,19 @@ def write_baseline(result, path="BASELINE.md", source=None):
                1e3 * x["warm_sweep_s"])
         ),
         fallback="Observability no-sink overhead: not measured in this "
+                 "artifact.",
+    ))
+    lines.append("")
+    lines.append(render(
+        d.get("report_100k_events"),
+        lambda x: (
+            "Run-report pipeline over a synthetic %d-event journal: "
+            "%d events/s (read+merge %.2f s, build+render %.2f s), "
+            "byte-identical across renders: %s."
+            % (x["n_events"], x["events_per_s"], x["read_merge_s"],
+               x["build_render_s"], x["deterministic"])
+        ),
+        fallback="Run-report pipeline throughput: not measured in this "
                  "artifact.",
     ))
     lines.append("")

@@ -39,7 +39,7 @@ import io
 import os
 import re
 import tokenize
-from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Type
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple, Type
 
 __all__ = [
     "Finding",
@@ -92,6 +92,17 @@ class SourceModule:
         #: scratch memo shared by rules (e.g. the resolved import map) so
         #: per-module derived structures are built once, not once per rule
         self.cache: Dict[str, object] = {}
+
+    def walk(self) -> "Tuple[ast.AST, ...]":
+        """Every node of :attr:`tree` in ``ast.walk`` order, computed once
+        and memoized. Most rules iterate the whole module; re-walking the
+        tree per rule was the dominant term of a full scan (the selfcheck
+        pins the gate under 5 s as the tree keeps growing)."""
+        nodes = self.cache.get("walk")
+        if nodes is None:
+            nodes = tuple(ast.walk(self.tree))
+            self.cache["walk"] = nodes
+        return nodes  # type: ignore[return-value]
 
     def is_suppressed(self, rule: str, line: int) -> bool:
         muted = self.suppressions.get(line, ())

@@ -92,7 +92,7 @@ class SwallowedExceptionRule(Rule):
             return []
         imports = import_map_for(module)
         findings: List[Finding] = []
-        for node in ast.walk(module.tree):
+        for node in module.walk():
             if not isinstance(node, ast.ExceptHandler):
                 continue
             if not _is_broad(node, imports) or _handles(node):

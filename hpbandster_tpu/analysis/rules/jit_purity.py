@@ -64,13 +64,19 @@ def _is_jit_expr(node: ast.AST, imports: ImportMap) -> bool:
 
 
 def traced_functions(
-    tree: ast.Module, imports: ImportMap
+    tree: ast.Module, imports: ImportMap, nodes=None
 ) -> Dict[ast.FunctionDef, Set[str]]:
     """Every function the module jits/vmaps/pmaps (decorator or wrapper-call
     position) -> its static parameter names. Shared by jit-host-sync and
-    obs-emit-in-jit: 'is this body traced?' is one question, answered once."""
+    obs-emit-in-jit: 'is this body traced?' is one question, answered once
+    (:func:`traced_functions_for` memoizes it per module).
+
+    ``nodes`` optionally supplies the module's pre-walked node sequence
+    (``SourceModule.walk()``) so this does not re-walk the whole tree."""
+    if nodes is None:
+        nodes = list(ast.walk(tree))
     by_name: Dict[str, List[ast.FunctionDef]] = {}
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.FunctionDef):
             by_name.setdefault(node.name, []).append(node)
 
@@ -79,7 +85,7 @@ def traced_functions(
     def mark(fn: ast.FunctionDef, static: Set[str]) -> None:
         traced.setdefault(fn, set()).update(static)
 
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.FunctionDef):
             for dec in node.decorator_list:
                 if _is_jit_expr(dec, imports):
@@ -90,6 +96,18 @@ def traced_functions(
                     if isinstance(inner, ast.Name) and inner.id in by_name:
                         for fn in by_name[inner.id]:
                             mark(fn, _static_params(node, fn))
+    return traced
+
+
+def traced_functions_for(module) -> Dict[ast.FunctionDef, Set[str]]:
+    """Per-module :func:`traced_functions`, built once and memoized on the
+    SourceModule (two rules ask the same question of every module)."""
+    traced = module.cache.get("traced_functions")
+    if traced is None:
+        traced = traced_functions(
+            module.tree, import_map_for(module), nodes=module.walk()
+        )
+        module.cache["traced_functions"] = traced
     return traced
 
 
@@ -132,7 +150,7 @@ class JitHostSyncRule(Rule):
         if not any(t in module.text for t in ("jit", "pmap", "vmap", "vectorize")):
             return []
         imports = import_map_for(module)
-        traced_fns = traced_functions(module.tree, imports)
+        traced_fns = traced_functions_for(module)
         findings: List[Finding] = []
         for fn, static in traced_fns.items():
             findings.extend(self._check_traced_fn(module, imports, fn, static))

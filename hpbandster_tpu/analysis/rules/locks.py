@@ -71,7 +71,7 @@ class LockCoverageRule(Rule):
             return []
         imports = import_map_for(module)
         findings: List[Finding] = []
-        for node in ast.walk(module.tree):
+        for node in module.walk():
             if isinstance(node, ast.ClassDef):
                 findings.extend(self._check_class(module, imports, node))
         return findings

@@ -27,7 +27,7 @@ from typing import List
 
 from hpbandster_tpu.analysis.core import Finding, Rule, SourceModule, register
 from hpbandster_tpu.analysis.rules._util import ImportMap, import_map_for
-from hpbandster_tpu.analysis.rules.jit_purity import traced_functions
+from hpbandster_tpu.analysis.rules.jit_purity import traced_functions_for
 
 _OBS_PREFIX = "hpbandster_tpu.obs"
 
@@ -63,7 +63,7 @@ class ObsEmitInJitRule(Rule):
         imports = import_map_for(module)
         imports_obs = _module_imports_obs(imports)
         findings: List[Finding] = []
-        for fn in traced_functions(module.tree, imports):
+        for fn in traced_functions_for(module):
             for node in ast.walk(fn):
                 if not isinstance(node, ast.Call):
                     continue

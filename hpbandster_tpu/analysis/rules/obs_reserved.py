@@ -57,7 +57,7 @@ class ObsReservedFieldsRule(Rule):
         imports = import_map_for(module)
         imports_obs = _module_imports_obs(imports)
         findings: List[Finding] = []
-        for node in ast.walk(module.tree):
+        for node in module.walk():
             if not isinstance(node, ast.Call):
                 continue
             bad = sorted(

@@ -69,7 +69,7 @@ class PRNGReuseRule(Rule):
             return []
         imports = import_map_for(module)
         findings: List[Finding] = []
-        for node in ast.walk(module.tree):
+        for node in module.walk():
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 findings.extend(_FunctionScan(self, module, imports, node).scan())
         return findings

@@ -76,6 +76,10 @@ class BaseIteration:
     configs (the config-generator seam that makes BOHB = HyperBand + KDE).
     """
 
+    #: audit label for promotion_decision records (obs/audit.py);
+    #: subclasses with a different promotion rule override it
+    promotion_rule: str = "successive_halving"
+
     def __init__(
         self,
         HPB_iter: int,
@@ -106,6 +110,10 @@ class BaseIteration:
         self.actual_num_configs = [0] * len(num_configs)
         self.is_finished = False
         self.num_running = 0
+        #: a promotion rule that ranks by something other than the raw
+        #: losses (H2BO extrapolation) stashes its per-candidate scores
+        #: here from _advance_to_next_stage; they ride the audit record
+        self.last_promotion_scores: Optional[List[Optional[float]]] = None
 
     # ------------------------------------------------------------- properties
     @property
@@ -145,6 +153,12 @@ class BaseIteration:
         self.actual_num_configs[self.stage] += 1
         if self.result_logger is not None:
             self.result_logger.new_config(config_id, config, config_info)
+        # the audit trail's birth record: the one place a config receives
+        # its id, so the generator's decision details (model vs random,
+        # KDE budget, l/g score — riding config_info) get linked to it
+        obs.emit_config_sampled(
+            config_id, self.budgets[self.stage], config_info
+        )
         return config_id
 
     def get_next_run(self) -> Optional[Tuple[ConfigId, Dict[str, Any], float]]:
@@ -254,7 +268,9 @@ class BaseIteration:
             )
             return True
 
+        self.last_promotion_scores = None
         advance = self._advance_to_next_stage(config_ids, losses)
+        rung = self.stage
         self.stage += 1
         next_budget = self.budgets[self.stage]
         for cid, promote in zip(config_ids, advance):
@@ -274,6 +290,17 @@ class BaseIteration:
             promoted=int(np.sum(advance)), candidates=len(config_ids),
             budget=budget, next_budget=next_budget,
         )
+        # the audit twin: full per-candidate detail (losses, mask, cut
+        # threshold, rule scores) — what report's regret table replays
+        obs.emit_promotion_decision(
+            self.HPB_iter, rung, budget, next_budget,
+            config_ids=config_ids,
+            losses=[None if np.isnan(l) else float(l) for l in losses],
+            promoted=[bool(a) for a in advance],
+            rule=self.promotion_rule,
+            scores=self.last_promotion_scores,
+        )
+        self.last_promotion_scores = None
         self.logger.debug(
             "iteration %d advanced to stage %d (%d promoted)",
             self.HPB_iter, self.stage, int(np.sum(advance)),

@@ -18,6 +18,7 @@ when the Master drains its ready queue and calls ``flush()``.
 from __future__ import annotations
 
 import logging
+import math
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -153,6 +154,8 @@ class Master:
         # Emitted under the job's own trace (not the ambient one): batched
         # tiers deliver many jobs from one thread, and each event must
         # carry its own job's trace_id.
+        loss = job.loss
+        run_s = job.mono_duration("started", "finished")
         with obs.use_trace(getattr(job, "trace", None)):
             obs.emit(
                 obs.JOB_FAILED if job.exception is not None else obs.JOB_FINISHED,
@@ -160,8 +163,17 @@ class Master:
                 budget=job.kwargs.get("budget"),
                 worker=job.worker_name,
                 queue_s=job.mono_duration("submitted", "started"),
-                run_s=job.mono_duration("started", "finished"),
+                run_s=run_s,
+                # non-finite (crashed NaN / diverged inf) journals as null
+                # — json.dumps would write bare NaN/Infinity, which strict
+                # JSON readers reject; the event name keeps the crashed vs
+                # finished distinction
+                loss=loss if math.isfinite(loss) else None,
             )
+        if isinstance(run_s, (int, float)):
+            # feeds the obs_snapshot `latency` section: evaluation-time
+            # quantiles visible over RPC with no journal on disk
+            obs.get_metrics().histogram("master.job_run_s").observe(run_s)
         with self.thread_cond:
             self.num_running_jobs -= 1
             if self.result_logger is not None:

@@ -22,6 +22,8 @@ __all__ = ["SuccessiveHalving", "SuccessiveResampling", "JaxSuccessiveHalving"]
 class SuccessiveHalving(BaseIteration):
     """Promote the best ``num_configs[next_stage]`` configs by loss rank."""
 
+    promotion_rule = "successive_halving"
+
     def _advance_to_next_stage(
         self, config_ids: List[ConfigId], losses: np.ndarray
     ) -> np.ndarray:
@@ -36,6 +38,8 @@ class SuccessiveResampling(BaseIteration):
     config generator instead of promoted (reference variant, SURVEY.md §2
     "SuccessiveResampling iteration").
     """
+
+    promotion_rule = "successive_resampling"
 
     def __init__(self, *args, resampling_rate: float = 0.5, min_samples_advance: int = 1, **kwargs):
         super().__init__(*args, **kwargs)
@@ -66,6 +70,8 @@ class JaxSuccessiveHalving(SuccessiveHalving):
     colocated with the accelerator (e.g. ``BOHB(..., iteration_class=
     JaxSuccessiveHalving)``) and the loss vector is already device-resident.
     """
+
+    promotion_rule = "successive_halving_jax"
 
     _jitted = None
 

@@ -286,6 +286,9 @@ class Worker:
                 config_id=list(config_id), budget=job_kwargs.get("budget"),
                 compute_s=round(compute_s, 6),
             )
+            # feeds this worker's obs_snapshot `latency` section — what
+            # `watch --snapshot <worker>` renders with no journal on disk
+            obs.get_metrics().histogram("worker.compute_s").observe(compute_s)
             self._deliver_result(
                 callback_uri, config_id,
                 {"result": result, "exception": exception},

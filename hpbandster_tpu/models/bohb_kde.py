@@ -36,7 +36,7 @@ from hpbandster_tpu.ops.kde import (
     KDE,
     normal_reference_bandwidths,
     propose,
-    propose_batch_seeded,
+    propose_batch_seeded_scored,
 )
 from hpbandster_tpu.space import ConfigurationSpace
 
@@ -297,14 +297,38 @@ class BOHBKDE(base_config_generator):
             # burst/warm-start path: record now, fit at the next proposal
             self._dirty_budgets.add(budget)
 
+    def _model_pick_info(
+        self, best_budget: float, lg_score: Optional[float]
+    ) -> Dict[str, Any]:
+        """The decision record a model-based pick carries (lands in
+        ``Datum.config_info``/results.json AND the ``config_sampled``
+        audit record via ``obs.audit.SAMPLING_INFO_KEYS``)."""
+        info: Dict[str, Any] = {
+            "model_based_pick": True,
+            "sample_reason": "model",
+            "model_budget": best_budget,
+            "n_points_in_model": len(self.losses.get(best_budget, ())),
+            "bandwidth_factor": self.bandwidth_factor,
+        }
+        if lg_score is not None:
+            info["lg_score"] = round(float(lg_score), 6)
+        return info
+
     def get_config(self, budget: float) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         best_budget = self.largest_budget_with_model()
         if best_budget is None or self.rng.uniform() < self.random_fraction:
             cfg = self.configspace.sample_configuration(rng=self.rng)
-            return dict(cfg), {"model_based_pick": False}
+            return dict(cfg), {
+                "model_based_pick": False,
+                # the audit distinction BOHB §3 hinges on: random because
+                # the model gate never opened, or the exploration coin
+                "sample_reason": (
+                    "no_model" if best_budget is None else "random_fraction"
+                ),
+            }
         try:
             good, bad = self._device_kde_pair(best_budget)
-            best_vec, _, _ = propose(
+            best_vec, _, scores = propose(
                 self._next_key(),
                 good,
                 bad,
@@ -315,14 +339,18 @@ class BOHBKDE(base_config_generator):
                 self.min_bandwidth,
             )
             cfg = self.configspace.from_vector(np.asarray(best_vec))
-            return dict(cfg), {
-                "model_based_pick": True,
-                "model_budget": best_budget,
-            }
+            # the winning l(x)/g(x) is the score the argmax already
+            # selected by — one extra scalar fetch on the trickle path
+            return dict(cfg), self._model_pick_info(
+                best_budget, float(jnp.max(scores))
+            )
         except Exception as e:  # fall back to random on any model failure
             self.logger.warning("model-based proposal failed (%s); sampling", e)
             cfg = self.configspace.sample_configuration(rng=self.rng)
-            return dict(cfg), {"model_based_pick": False}
+            return dict(cfg), {
+                "model_based_pick": False,
+                "sample_reason": "model_failure",
+            }
 
     def get_config_batch(
         self, budget: float, n: int
@@ -332,7 +360,7 @@ class BOHBKDE(base_config_generator):
         best_budget = self.largest_budget_with_model()
         if best_budget is None:
             return [
-                (dict(c), {"model_based_pick": False})
+                (dict(c), {"model_based_pick": False, "sample_reason": "no_model"})
                 for c in self.configspace.sample_configuration(n, rng=self.rng)
             ]
         use_model = self.rng.uniform(size=n) >= self.random_fraction
@@ -345,33 +373,42 @@ class BOHBKDE(base_config_generator):
             # fresh XLA compile. Keys derive on-device from one scalar seed.
             n_pad = _pow2_capacity(n_model, minimum=self.proposal_batch_size)
             seed = jnp.uint32(self.rng.integers(2**32, dtype=np.uint32))
+            scores: Optional[np.ndarray] = None
             if self.use_pallas:
+                # the Pallas pipeline keeps scoring fused on-device and
+                # returns vectors only — the audit record goes score-less
                 vecs = self._propose_batch_pallas(seed, good, bad, n_pad)[:n_model]
             else:
-                vecs = np.asarray(
-                    propose_batch_seeded(
-                        seed,
-                        good,
-                        bad,
-                        self._vartypes_dev,
-                        self._cards_dev,
-                        n_pad,
-                        self.num_samples,
-                        self.bandwidth_factor,
-                        self.min_bandwidth,
-                    )
-                )[:n_model]
+                dev_vecs, dev_scores = propose_batch_seeded_scored(
+                    seed,
+                    good,
+                    bad,
+                    self._vartypes_dev,
+                    self._cards_dev,
+                    n_pad,
+                    self.num_samples,
+                    self.bandwidth_factor,
+                    self.min_bandwidth,
+                )
+                vecs = np.asarray(dev_vecs)[:n_model]
+                scores = np.asarray(dev_scores)[:n_model]
             k = 0
             for i in range(n):
                 if use_model[i]:
                     cfg = self.configspace.from_vector(vecs[k])
                     out[i] = (
                         dict(cfg),
-                        {"model_based_pick": True, "model_budget": best_budget},
+                        self._model_pick_info(
+                            best_budget,
+                            None if scores is None else float(scores[k]),
+                        ),
                     )
                     k += 1
         for i in range(n):
             if out[i] is None:
                 cfg = self.configspace.sample_configuration(rng=self.rng)
-                out[i] = (dict(cfg), {"model_based_pick": False})
+                out[i] = (
+                    dict(cfg),
+                    {"model_based_pick": False, "sample_reason": "random_fraction"},
+                )
         return out  # type: ignore[return-value]

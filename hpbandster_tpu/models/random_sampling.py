@@ -27,15 +27,19 @@ class RandomSampling(base_config_generator):
         self.configspace = configspace
         self.rng = np.random.default_rng(seed)
 
+    #: audit detail for config_sampled records (obs/audit.py): this
+    #: generator has no model — every pick is a deliberate random draw
+    _INFO = {"model_based_pick": False, "sample_reason": "random_search"}
+
     def get_config(self, budget: float) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         cfg = self.configspace.sample_configuration(rng=self.rng)
-        return dict(cfg), {"model_based_pick": False}
+        return dict(cfg), dict(self._INFO)
 
     def get_config_batch(
         self, budget: float, n: int
     ) -> List[Tuple[Dict[str, Any], Dict[str, Any]]]:
         return [
-            (dict(c), {"model_based_pick": False})
+            (dict(c), dict(self._INFO))
             for c in self.configspace.sample_configuration(n, rng=self.rng)
         ]
 

@@ -16,11 +16,20 @@ emit into (see docs/observability.md):
   across RPC hops (the ``_obs`` envelope in ``parallel/rpc.py``), stamped
   onto every event as ``trace_id``;
 * :mod:`~hpbandster_tpu.obs.health` — the ``obs_snapshot`` fleet-health
-  RPC endpoint + :func:`install_crash_dump` forensics;
+  RPC endpoint (+ latency quantiles) + :func:`install_crash_dump`
+  forensics;
+* :mod:`~hpbandster_tpu.obs.audit` — the optimizer decision audit:
+  ``config_sampled`` / ``promotion_decision`` records (why BOHB sampled
+  a config, what a rung promotion decided) + :func:`config_lineage`;
+* :mod:`~hpbandster_tpu.obs.anomaly` — streaming anomaly detection
+  (stragglers, flapping workers, NaN bursts, KDE-refit stalls) emitting
+  ``alert`` events + counters;
 * ``python -m hpbandster_tpu.obs summarize <journal> [<journal> ...]`` —
   per-stage latency percentiles, worker utilization, failure tallies, and
-  merged cross-host per-trace timelines; ``watch <journal>`` tails a live
-  run.
+  merged cross-host per-trace timelines; ``report`` renders the
+  deterministic optimizer story (incumbent trajectory, model-vs-random
+  win rate, promotion regret, alert digest); ``watch <journal>`` tails a
+  live run (``watch --snapshot host:port`` polls a health RPC instead).
 
 Everything here is stdlib-only and costs ~nothing when no sink is
 attached (the bench's ``obs_overhead`` tier measures exactly that), so
@@ -44,15 +53,30 @@ from typing import Any, Callable, Dict, List, Optional, Union
 
 from hpbandster_tpu.obs import events as _events
 from hpbandster_tpu.obs import metrics as _metrics
+from hpbandster_tpu.obs.anomaly import (  # noqa: F401
+    AnomalyDetector,
+    AnomalyRules,
+    scan_records,
+)
+from hpbandster_tpu.obs.audit import (  # noqa: F401
+    AUDIT_EVENTS,
+    config_lineage,
+    emit_bracket_created,
+    emit_config_sampled,
+    emit_promotion_decision,
+)
 from hpbandster_tpu.obs.events import (  # noqa: F401
+    ALERT,
     BRACKET_PROMOTION,
     CHECKPOINT_WRITTEN,
+    CONFIG_SAMPLED,
     EVENT_TYPES,
     JOB_FAILED,
     JOB_FINISHED,
     JOB_STARTED,
     JOB_SUBMITTED,
     KDE_REFIT,
+    PROMOTION_DECISION,
     RESULT_DELIVERED,
     RPC_RETRY,
     UNKNOWN_RESULT,
@@ -100,11 +124,15 @@ __all__ = [
     "TraceContext", "new_trace", "current_trace", "use_trace",
     "current_wire", "extract_wire",
     "HealthEndpoint", "install_crash_dump",
+    "AnomalyDetector", "AnomalyRules", "scan_records",
+    "AUDIT_EVENTS", "config_lineage", "emit_bracket_created",
+    "emit_config_sampled", "emit_promotion_decision",
     "configure", "set_enabled", "enabled",
     "EVENT_TYPES", "JOB_SUBMITTED", "JOB_STARTED", "JOB_FINISHED",
     "JOB_FAILED", "WORKER_DISCOVERED", "WORKER_DROPPED",
     "BRACKET_PROMOTION", "KDE_REFIT", "RPC_RETRY", "RESULT_DELIVERED",
     "CHECKPOINT_WRITTEN", "UNKNOWN_RESULT",
+    "CONFIG_SAMPLED", "PROMOTION_DECISION", "ALERT",
 ]
 
 
@@ -123,10 +151,12 @@ class ObsHandle:
     """What :func:`configure` returns: the attached sinks + one close()."""
 
     def __init__(self, detachers: List[Callable[[], None]],
-                 journal: Optional[JsonlJournal], ring: Optional[RingBuffer]):
+                 journal: Optional[JsonlJournal], ring: Optional[RingBuffer],
+                 anomaly: Optional[AnomalyDetector] = None):
         self._detachers = detachers
         self.journal = journal
         self.ring = ring
+        self.anomaly = anomaly
 
     def close(self) -> None:
         """Detach every sink and close the journal file (idempotent)."""
@@ -150,6 +180,7 @@ def configure(
     ring_capacity: int = 0,
     identity: Union[bool, Dict[str, Any], None] = None,
     bus: Optional[EventBus] = None,
+    anomaly: Union[bool, AnomalyRules, None] = None,
 ) -> ObsHandle:
     """Attach the standard sinks to ``bus`` (default: the process bus).
 
@@ -159,12 +190,16 @@ def configure(
     ``True`` for the automatic ``{host, pid}`` pair, or a dict of extra
     fields (``{"worker_id": ...}``) merged over it — the stamp that lets
     ``summarize a.jsonl b.jsonl`` attribute merged cross-host records.
-    Returns an :class:`ObsHandle` — close it to detach (tests and
-    multi-run processes must, or sinks accumulate)."""
+    ``anomaly`` attaches a streaming :class:`AnomalyDetector` (``True``
+    for default :class:`AnomalyRules`, or pass tuned rules); its ``alert``
+    events land in the same journal and its tally is on the handle as
+    ``handle.anomaly``. Returns an :class:`ObsHandle` — close it to
+    detach (tests and multi-run processes must, or sinks accumulate)."""
     bus = bus if bus is not None else get_bus()
     detachers: List[Callable[[], None]] = []
     journal = None
     ring = None
+    detector = None
     if journal_path is not None:
         static = None
         if identity:
@@ -179,4 +214,10 @@ def configure(
     if ring_capacity > 0:
         ring = RingBuffer(ring_capacity)
         detachers.append(bus.subscribe(ring))
-    return ObsHandle(detachers, journal, ring)
+    if anomaly:
+        detector = AnomalyDetector(
+            rules=anomaly if isinstance(anomaly, AnomalyRules) else None,
+            bus=bus,
+        )
+        detachers.append(bus.subscribe(detector))
+    return ObsHandle(detachers, journal, ring, detector)

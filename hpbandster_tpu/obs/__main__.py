@@ -4,10 +4,19 @@
   (possibly rotated) journals by wall clock; print per-stage latency
   percentiles, worker utilization, failure tallies, and the merged
   per-trace timelines (queue wait -> dispatch -> compute -> delivery).
+* ``report <journal> [<journal> ...] [--json]`` — the optimizer-decision
+  view (``obs/report.py``): incumbent trajectory, model-vs-random win
+  rate, per-rung promotion regret, bracket utilization, alert digest.
+  Deterministic: two invocations over the same journals are
+  byte-identical.
 * ``watch <journal> [--interval S] [--ticks N]`` — tail a live journal,
   one status line per tick; runs until ^C unless ``--ticks`` bounds it.
+  ``watch --snapshot <uri>`` polls a live process's ``obs_snapshot``
+  health RPC instead — latency quantiles with no journal on disk.
 
-Exit codes: 0 success, 2 usage error / unreadable journal.
+Corrupt/truncated JSONL lines are skipped with a counted stderr warning,
+never fatal (a post-mortem reader must survive the crash it documents).
+Exit codes: 0 success, 2 usage error / missing journal.
 """
 
 from __future__ import annotations
@@ -19,12 +28,40 @@ import sys
 from typing import List, Optional
 
 from hpbandster_tpu.obs.journal import journal_paths
+from hpbandster_tpu.obs.report import build_report, format_report
 from hpbandster_tpu.obs.summarize import (
     format_summary,
-    read_merged,
+    read_merged_ex,
     summarize_records,
     watch_journal,
+    watch_snapshot,
 )
+
+
+def _missing_journals(paths: List[str]) -> List[str]:
+    return [
+        p for p in paths
+        if not os.path.exists(p) and not journal_paths(p)
+    ]
+
+
+def _read_checked(paths: List[str]) -> Optional[list]:
+    """Merged records, or None (after a clear stderr message) when any
+    journal is missing; corrupt lines are counted and warned about."""
+    missing = _missing_journals(paths)
+    if missing:
+        print(
+            f"error: journal(s) {', '.join(repr(p) for p in missing)} do not exist",
+            file=sys.stderr,
+        )
+        return None
+    records, skipped = read_merged_ex(paths)
+    if skipped:
+        print(
+            f"warning: skipped {skipped} corrupt/truncated journal line(s)",
+            file=sys.stderr,
+        )
+    return records
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -46,10 +83,32 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--json", action="store_true", dest="as_json",
         help="emit the summary as JSON instead of text",
     )
-    p_watch = sub.add_parser(
-        "watch", help="tail a live journal, one status line per tick"
+    p_rep = sub.add_parser(
+        "report",
+        help="optimizer decision report: incumbent trajectory, "
+        "model-vs-random win rate, promotion regret, alert digest",
     )
-    p_watch.add_argument("journal", help="path to a (possibly future) journal")
+    p_rep.add_argument(
+        "journals", nargs="+", metavar="journal",
+        help="JSONL run journal(s) — merged before analysis",
+    )
+    p_rep.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the report as JSON instead of text",
+    )
+    p_watch = sub.add_parser(
+        "watch", help="tail a live journal (or poll a health RPC), "
+        "one status line per tick"
+    )
+    p_watch.add_argument(
+        "journal", nargs="?", default=None,
+        help="path to a (possibly future) journal",
+    )
+    p_watch.add_argument(
+        "--snapshot", metavar="URI", default=None,
+        help="poll obs_snapshot on this RPC endpoint (host:port) instead "
+        "of tailing a journal — latency quantiles without a journal",
+    )
     p_watch.add_argument(
         "--interval", type=float, default=2.0, help="seconds between ticks"
     )
@@ -60,19 +119,36 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     if args.command == "watch":
+        if args.snapshot is not None:
+            if args.journal is not None:
+                print(
+                    "error: watch takes a journal path OR --snapshot, "
+                    "not both",
+                    file=sys.stderr,
+                )
+                return 2
+            return watch_snapshot(
+                args.snapshot, interval=args.interval, ticks=args.ticks
+            )
+        if args.journal is None:
+            print(
+                "error: watch needs a journal path or --snapshot URI",
+                file=sys.stderr,
+            )
+            return 2
         return watch_journal(args.journal, interval=args.interval, ticks=args.ticks)
 
-    missing = [
-        p for p in args.journals
-        if not os.path.exists(p) and not journal_paths(p)
-    ]
-    if missing:
-        print(
-            f"error: journal(s) {', '.join(repr(p) for p in missing)} do not exist",
-            file=sys.stderr,
-        )
+    records = _read_checked(args.journals)
+    if records is None:
         return 2
-    summary = summarize_records(read_merged(args.journals))
+    if args.command == "report":
+        rep = build_report(records)
+        if args.as_json:
+            print(json.dumps(rep, indent=1, sort_keys=True))
+        else:
+            print(format_report(rep))
+        return 0
+    summary = summarize_records(records)
     if args.as_json:
         print(json.dumps(summary, indent=1))
     else:

@@ -1,0 +1,315 @@
+"""Streaming anomaly detection over the event bus.
+
+A :class:`AnomalyDetector` is an ordinary bus sink: subscribe it (or let
+``obs.configure(anomaly=True)`` do it) and it watches the run's event
+stream for the failure shapes an HPO fleet actually exhibits, emitting
+``alert`` events (plus ``anomaly.alerts*`` counters) the moment a rule
+fires — surfaced live by ``watch``, by the ``obs_snapshot`` health RPC,
+and post-hoc by the report CLI's alert digest.
+
+Rules (every threshold is a knob on :class:`AnomalyRules`, see
+docs/observability.md "Alert rules"):
+
+* **straggler** — a duration-carrying event (``run_s``, ``compute_s``,
+  any span's ``duration_s``) exceeding ``straggler_factor`` × the rolling
+  per-stage p95. Catches the one worker quietly 10× slower than its
+  peers, which percentile summaries alone hide until the journal is read.
+* **worker_flapping** — the same worker dropped ``flap_threshold`` times
+  within ``flap_window_s``: a host that keeps rejoining and dying wastes
+  requeues and poisons utilization; dropping it once is routine, cycling
+  is an incident.
+* **nan_burst** — ``nan_burst_threshold`` non-finite-loss / failed
+  evaluations within the last ``nan_burst_window`` results. One diverged
+  config is BOHB-normal (crashed-as-worst); a burst means the objective
+  or a budget rung is broken.
+* **kde_refit_stall** — ``kde_stall_results`` results ingested since the
+  last ``kde_refit`` while a model exists: the optimizer has silently
+  degraded to random search (e.g. every new result lands on a budget
+  whose fit keeps failing the min-points gate).
+
+The detector never raises into the bus (rule state is all stdlib), never
+reacts to its own ``alert`` events, and rate-limits per (rule, subject)
+via ``cooldown_s`` so one stuck worker cannot flood the journal.
+
+Offline, :func:`scan_records` replays the same rules deterministically
+over journal records — timestamps come from the records, not the wall
+clock — which is how ``report`` synthesizes an alert digest for runs
+that journaled without a live detector attached.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import threading
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+from hpbandster_tpu.obs import events as E
+from hpbandster_tpu.obs.journal import event_to_record
+from hpbandster_tpu.obs.metrics import MetricsRegistry, get_metrics
+
+__all__ = ["AnomalyRules", "AnomalyDetector", "scan_records"]
+
+#: duration fields a record may carry, in stage-name terms: the master's
+#: end-to-end run_s, the worker's compute_s, and any span's duration_s
+#: (keyed by the span's event name)
+_DURATION_FIELDS = ("run_s", "compute_s", "duration_s")
+
+
+@dataclasses.dataclass(frozen=True)
+class AnomalyRules:
+    """Tuning knobs; defaults sized for minutes-scale HPO evaluations."""
+
+    #: straggler: value > factor × rolling p95 of the same stage (the
+    #: stage key includes the budget — multi-fidelity rungs never pool)
+    straggler_factor: float = 3.0
+    #: ... but only once the stage has this many samples (cold-start guard)
+    straggler_min_samples: int = 20
+    #: rolling window per stage (samples)
+    straggler_window: int = 256
+    #: p95 floor inside the threshold (factor × max(p95, floor)): a
+    #: micro-duration baseline cannot flag trivial blips as "30×", while
+    #: a genuinely huge outlier still fires
+    straggler_floor_s: float = 0.05
+
+    #: worker_flapping: this many drops of one worker within the window
+    flap_threshold: int = 3
+    flap_window_s: float = 600.0
+
+    #: nan_burst: this many bad results within the last window results
+    nan_burst_threshold: int = 5
+    nan_burst_window: int = 32
+
+    #: kde_refit_stall: results since the last refit (0 disables)
+    kde_stall_results: int = 64
+
+    #: per-(rule, subject) re-alert suppression
+    cooldown_s: float = 60.0
+
+
+class AnomalyDetector:
+    """Bus sink / record processor implementing the rules above.
+
+    ``bus=None`` (offline mode) collects alert records on ``.alerts``
+    without emitting or counting; with a bus, every fired rule emits one
+    ``alert`` event and increments ``anomaly.alerts`` plus
+    ``anomaly.alerts.<rule>``.
+
+    Thread-safe like every other sink (the bus delivers from whichever
+    thread emitted — master, ping loop, and RPC handler threads all emit
+    concurrently): rule state mutates under one internal RLock (re-entrant
+    because firing an alert re-enters the sink via the bus before the
+    ALERT-name guard can skip it). State is plain dicts/deques sized by
+    the rule windows, so memory is bounded regardless of run length.
+    """
+
+    def __init__(
+        self,
+        rules: Optional[AnomalyRules] = None,
+        bus: Optional[Any] = None,
+        registry: Optional[MetricsRegistry] = None,
+    ):
+        self.rules = rules or AnomalyRules()
+        self._bus = bus
+        self._registry = registry
+        self._lock = threading.RLock()
+        #: every alert this detector fired (record dicts, oldest first),
+        #: bounded so a pathological run cannot grow it without limit
+        self.alerts: Deque[Dict[str, Any]] = collections.deque(maxlen=256)
+        self.alert_counts: Dict[str, int] = {}
+        # rule state
+        self._stage_windows: Dict[str, Deque[float]] = {}
+        self._drop_times: Dict[str, Deque[float]] = {}
+        self._result_window: Deque[int] = collections.deque(
+            maxlen=max(int(self.rules.nan_burst_window), 1)
+        )
+        self._results_since_refit = 0
+        self._refit_seen = False
+        self._last_alert: Dict[Tuple[str, str], float] = {}
+
+    # ------------------------------------------------------------- plumbing
+    def __call__(self, event: Any) -> None:
+        """Bus-sink entry point; must never raise into the bus."""
+        try:
+            self.process(event_to_record(event))
+        except Exception:
+            E.logger.exception("anomaly detector failed on %r", event)
+
+    def _fire(
+        self, rec: Dict[str, Any], rule: str, subject: str, **detail: Any
+    ) -> Optional[Dict[str, Any]]:
+        now = rec.get("t_wall")
+        now = float(now) if isinstance(now, (int, float)) else 0.0
+        key = (rule, subject)
+        last = self._last_alert.get(key)
+        if last is not None and now - last < self.rules.cooldown_s:
+            return None
+        self._last_alert[key] = now
+        alert = {
+            "event": E.ALERT,
+            "t_wall": now,
+            "t_mono": rec.get("t_mono"),
+            "rule": rule,
+            "subject": subject,
+            "source_event": rec.get("event"),
+            **detail,
+        }
+        self.alerts.append(alert)
+        self.alert_counts[rule] = self.alert_counts.get(rule, 0) + 1
+        if self._bus is not None:
+            reg = self._registry if self._registry is not None else get_metrics()
+            reg.counter("anomaly.alerts").inc()
+            reg.counter(f"anomaly.alerts.{rule}").inc()
+            self._bus.emit(
+                E.ALERT,
+                rule=rule, subject=subject,
+                source_event=rec.get("event"),
+                **detail,
+            )
+        return alert
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-serializable detector state for the health endpoint."""
+        with self._lock:
+            return {
+                "total": sum(self.alert_counts.values()),
+                "by_rule": dict(sorted(self.alert_counts.items())),
+                "recent": list(self.alerts)[-8:],
+            }
+
+    # ----------------------------------------------------------------- rules
+    def process(self, rec: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Run every rule over one journal-schema record; returns the
+        alerts fired (already emitted/counted when a bus is attached)."""
+        name = rec.get("event")
+        if not name or name == E.ALERT:
+            return []
+        with self._lock:
+            return self._process_locked(rec, name)
+
+    def _process_locked(
+        self, rec: Dict[str, Any], name: str
+    ) -> List[Dict[str, Any]]:
+        fired: List[Dict[str, Any]] = []
+        r = self.rules
+
+        # --- straggler: per-stage rolling p95. The window keys include
+        # the budget: a budget-9 evaluation is ~9x a budget-1 one by
+        # DESIGN in a multi-fidelity sweep, and pooling them would fire
+        # a false alert at every rung transition.
+        for field in _DURATION_FIELDS:
+            v = rec.get(field)
+            if not isinstance(v, (int, float)) or not math.isfinite(v):
+                continue
+            budget = rec.get("budget")
+            stage = f"{name}.{field}" + (
+                f"@{budget:g}" if isinstance(budget, (int, float)) else ""
+            )
+            win = self._stage_windows.get(stage)
+            if win is None:
+                win = self._stage_windows[stage] = collections.deque(
+                    maxlen=max(int(r.straggler_window), 2)
+                )
+            if len(win) >= r.straggler_min_samples:
+                ordered = sorted(win)
+                p95 = ordered[min(
+                    int(round(0.95 * (len(ordered) - 1))), len(ordered) - 1
+                )]
+                # the floor enters the THRESHOLD: a baseline of micro
+                # durations (p95 ~2ms) must not flag a trivial 60ms blip
+                # at "30x", yet a genuinely huge outlier still fires
+                cut = r.straggler_factor * max(p95, r.straggler_floor_s)
+                if v > cut:
+                    a = self._fire(
+                        rec, "straggler", stage,
+                        value_s=round(float(v), 6),
+                        p95_s=round(float(p95), 6),
+                        # a 0.0 baseline (sub-microsecond stage) has no
+                        # meaningful ratio; the floor-based cut still fired
+                        factor=round(float(v) / p95, 2) if p95 > 0 else None,
+                        worker=rec.get("worker"),
+                        config_id=rec.get("config_id"),
+                    )
+                    if a:
+                        fired.append(a)
+            win.append(float(v))
+
+        # --- worker flapping: repeated drops of one worker
+        if name == E.WORKER_DROPPED:
+            worker = str(rec.get("worker") or "?")
+            tw = rec.get("t_wall")
+            tw = float(tw) if isinstance(tw, (int, float)) else 0.0
+            times = self._drop_times.setdefault(
+                worker, collections.deque(maxlen=max(int(r.flap_threshold), 1) * 4)
+            )
+            times.append(tw)
+            recent = [t for t in times if tw - t <= r.flap_window_s]
+            if len(recent) >= r.flap_threshold:
+                a = self._fire(
+                    rec, "worker_flapping", worker,
+                    drops=len(recent), window_s=r.flap_window_s,
+                )
+                if a:
+                    fired.append(a)
+
+        # --- result-driven rules (the loss-carrying record is the master
+        # funnel's / fused replay's — exactly one per job, so counting
+        # those avoids double-counting the worker-side twins)
+        if name in (E.JOB_FINISHED, E.JOB_FAILED) and "loss" in rec:
+            loss = rec.get("loss")
+            # bad = failed, OR no finite loss: the emitters journal any
+            # non-finite (NaN/inf-diverged) loss as null for strict JSON,
+            # so null on a loss-carrying record IS the divergence signal
+            # (the isfinite check additionally covers foreign journals
+            # that wrote raw non-finite values)
+            bad = name == E.JOB_FAILED or loss is None or (
+                isinstance(loss, (int, float)) and not math.isfinite(loss)
+            )
+            self._result_window.append(1 if bad else 0)
+            if (
+                sum(self._result_window) >= r.nan_burst_threshold
+                and len(self._result_window) > 0
+            ):
+                a = self._fire(
+                    rec, "nan_burst", "losses",
+                    bad_results=sum(self._result_window),
+                    window=self._result_window.maxlen,
+                    config_id=rec.get("config_id"),
+                )
+                if a:
+                    fired.append(a)
+                    self._result_window.clear()
+            if r.kde_stall_results > 0 and self._refit_seen:
+                self._results_since_refit += 1
+                if self._results_since_refit > r.kde_stall_results:
+                    a = self._fire(
+                        rec, "kde_refit_stall", "kde",
+                        results_since_refit=self._results_since_refit,
+                        stall_after=r.kde_stall_results,
+                    )
+                    if a:
+                        fired.append(a)
+                        self._results_since_refit = 0
+        elif name == E.KDE_REFIT:
+            self._refit_seen = True
+            self._results_since_refit = 0
+
+        return fired
+
+
+def scan_records(
+    records: List[Dict[str, Any]],
+    rules: Optional[AnomalyRules] = None,
+) -> List[Dict[str, Any]]:
+    """Offline, deterministic replay of the rules over journal records.
+
+    No bus, no metrics, no wall clock — alerts are stamped with the
+    triggering record's ``t_wall``/``t_mono``, so two scans of the same
+    journal produce identical output (the report CLI's determinism bar).
+    """
+    det = AnomalyDetector(rules=rules, bus=None)
+    out: List[Dict[str, Any]] = []
+    for rec in records:
+        out.extend(det.process(rec))
+    return out

@@ -1,0 +1,229 @@
+"""Optimizer decision audit: why did BOHB do what it did?
+
+PRs 2–3 made the *infrastructure* observable; this module makes the
+*algorithm* observable. Two record kinds ride the existing JSONL journal
+schema (``docs/observability.md`` "Optimizer decision audit"):
+
+* ``config_sampled`` — one record per config entering a bracket, emitted
+  by :meth:`core.iteration.BaseIteration.add_configuration` (the one
+  place a config receives its id). The decision details come from the
+  config generator's info dict: was the pick model-based or random (and
+  WHY random — no trained model yet vs the ``random_fraction`` coin vs a
+  model failure), which budget's KDE proposed it, how many observations
+  that model had, and the winning ``log l(x) - log g(x)`` acquisition
+  score (BOHB §3, Falkner et al. 2018).
+* ``promotion_decision`` — one record per rung advancement, emitted by
+  :meth:`core.iteration.BaseIteration.process_results`: the rung, its
+  budget and the next one, every candidate's loss, the promotion mask,
+  and the effective cut threshold (the worst promoted loss). When the
+  promotion rule ranked by something other than the raw losses (H2BO's
+  learning-curve extrapolation), the rule's scores ride along — the
+  record shows what the decision was actually based on.
+
+Both kinds carry ``config_id`` triples, so
+:func:`config_lineage` can replay a journal into per-config stories
+(sampled → evaluated per budget → promoted/terminated at each rung) —
+the join the report CLI (``obs/report.py``) builds its model-vs-random
+win rate and promotion-regret tables from.
+
+Emission goes through the event bus, so the no-sink cost is the usual
+~zero (the ``audit_emit_ns`` micro in the bench's ``obs_overhead`` tier
+measures it), and the ``obs-reserved-fields`` graftlint rule applies
+unchanged: audit call sites never stamp ``trace_id``/``host`` by hand.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from hpbandster_tpu.obs import events as E
+
+__all__ = [
+    "AUDIT_EVENTS",
+    "SAMPLING_INFO_KEYS",
+    "emit_bracket_created",
+    "emit_config_sampled",
+    "emit_promotion_decision",
+    "config_key",
+    "config_lineage",
+]
+
+#: the audit vocabulary (subset of ``obs.EVENT_TYPES``)
+AUDIT_EVENTS = frozenset({E.CONFIG_SAMPLED, E.PROMOTION_DECISION})
+
+#: config-generator info keys copied into the ``config_sampled`` record.
+#: Generators attach these to the info dict they already return (the dict
+#: that lands in ``Datum.config_info`` / results.json), so the audit
+#: record and the Result stay consistent by construction.
+SAMPLING_INFO_KEYS = (
+    "model_based_pick",   # bool — model proposal vs random draw
+    "sample_reason",      # "model" | "no_model" | "random_fraction" | "model_failure" | "random_search" | "fused_sweep"
+    "model_budget",       # which budget's KDE proposed it
+    "n_points_in_model",  # observations the proposing KDE was fit on
+    "lg_score",           # winning log l(x) - log g(x) acquisition score
+    "bandwidth_factor",   # sampling bandwidth multiplier in effect
+)
+
+
+def emit_bracket_created(
+    iteration: int,
+    num_configs: Sequence[int],
+    budgets: Sequence[float],
+    eta: Optional[float] = None,
+    random_fraction: Optional[float] = None,
+) -> None:
+    """One ``bracket_created`` record — the bracket plan plus the knobs
+    its sampling decisions run under. The single emitter every optimizer
+    tier (BOHB, H2BO, fused replay) calls, so the record shape the
+    report's bracket table consumes cannot drift between tiers."""
+    E.emit(
+        "bracket_created",
+        iteration=int(iteration),
+        num_configs=list(num_configs),
+        budgets=list(budgets),
+        eta=eta,
+        random_fraction=random_fraction,
+    )
+
+
+def emit_config_sampled(
+    config_id: Sequence[int],
+    budget: float,
+    config_info: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Emit one per-sample decision record (no-op with no sink attached).
+
+    Only the :data:`SAMPLING_INFO_KEYS` present in ``config_info`` are
+    copied — a generator that predates a key simply produces a sparser
+    record, never a schema error.
+    """
+    if not E.get_bus().active:
+        return  # no sink: skip even the field-dict build (hot sample loop)
+    fields: Dict[str, Any] = {
+        "config_id": list(config_id), "budget": budget,
+    }
+    if config_info:
+        for key in SAMPLING_INFO_KEYS:
+            if key in config_info:
+                fields[key] = config_info[key]
+    E.emit(E.CONFIG_SAMPLED, **fields)
+
+
+def emit_promotion_decision(
+    iteration: int,
+    rung: int,
+    budget: float,
+    next_budget: Optional[float],
+    config_ids: Sequence[Sequence[int]],
+    losses: Sequence[Optional[float]],
+    promoted: Sequence[bool],
+    rule: str = "successive_halving",
+    scores: Optional[Sequence[Optional[float]]] = None,
+) -> None:
+    """Emit one per-rung promotion record (no-op with no sink attached).
+
+    ``losses`` may contain None (crashed configs); ``scores`` is the
+    promotion rule's ranking values when they differ from the raw losses
+    (H2BO extrapolation). The cut threshold is the worst promoted loss —
+    the rung's effective survival bar in hindsight analysis.
+    """
+    if not E.get_bus().active:
+        return  # no sink: skip the per-candidate list builds
+    promoted = [bool(p) for p in promoted]
+    survivor_losses = [
+        l for l, p in zip(losses, promoted) if p and l is not None
+    ]
+    fields: Dict[str, Any] = {
+        "iteration": int(iteration),
+        "rung": int(rung),
+        "budget": budget,
+        "next_budget": next_budget,
+        "rule": rule,
+        "config_ids": [list(cid) for cid in config_ids],
+        "losses": list(losses),
+        "promoted": promoted,
+        "n_promoted": sum(promoted),
+        "n_candidates": len(promoted),
+        "cut_threshold": max(survivor_losses) if survivor_losses else None,
+        "survivor_losses": sorted(survivor_losses),
+    }
+    if scores is not None:
+        fields["scores"] = list(scores)
+    E.emit(E.PROMOTION_DECISION, **fields)
+
+
+# ------------------------------------------------------------------ replay
+def config_key(config_id: Any) -> Optional[Tuple[int, ...]]:
+    """Journal ``config_id`` field -> hashable lineage key (or None)."""
+    if isinstance(config_id, (list, tuple)) and config_id:
+        try:
+            return tuple(int(x) for x in config_id)
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
+def config_lineage(
+    records: List[Dict[str, Any]],
+) -> Dict[Tuple[int, ...], Dict[str, Any]]:
+    """Replay journal records into per-config decision lineages.
+
+    Returns ``{config_id: lineage}`` where each lineage carries:
+
+    * ``sampled`` — the ``config_sampled`` audit fields (first wins);
+    * ``results`` — ``{budget: loss}`` from master-side
+      ``job_finished`` records (first completed evaluation per budget;
+      ``None`` = crashed);
+    * ``rungs`` — ordered ``(iteration, rung, budget, promoted)``
+      promotion outcomes this config was a candidate in.
+
+    Deterministic in the record order (callers pass
+    ``summarize.read_merged`` output, which is wall-clock sorted).
+    """
+    lineages: Dict[Tuple[int, ...], Dict[str, Any]] = {}
+
+    def slot(key: Tuple[int, ...]) -> Dict[str, Any]:
+        return lineages.setdefault(
+            key, {"sampled": None, "results": {}, "rungs": []}
+        )
+
+    for rec in records:
+        name = rec.get("event")
+        if name == E.CONFIG_SAMPLED:
+            key = config_key(rec.get("config_id"))
+            if key is None:
+                continue
+            s = slot(key)
+            if s["sampled"] is None:
+                s["sampled"] = {
+                    k: rec[k] for k in SAMPLING_INFO_KEYS if k in rec
+                }
+        elif name in (E.JOB_FINISHED, E.JOB_FAILED):
+            key = config_key(rec.get("config_id"))
+            budget = rec.get("budget")
+            # the loss-carrying record is authoritative (master funnel /
+            # fused replay); worker-side twins carry compute_s, no loss
+            if key is None or not isinstance(budget, (int, float)):
+                continue
+            if "loss" not in rec:
+                continue
+            s = slot(key)
+            if float(budget) not in s["results"]:
+                loss = rec.get("loss")
+                s["results"][float(budget)] = (
+                    float(loss) if isinstance(loss, (int, float)) else None
+                )
+        elif name == E.PROMOTION_DECISION:
+            ids = rec.get("config_ids")
+            promoted = rec.get("promoted")
+            if not isinstance(ids, list) or not isinstance(promoted, list):
+                continue
+            for cid, prom in zip(ids, promoted):
+                key = config_key(cid)
+                if key is None:
+                    continue
+                slot(key)["rungs"].append((
+                    rec.get("iteration"), rec.get("rung"),
+                    rec.get("budget"), bool(prom),
+                ))
+    return lineages

@@ -54,6 +54,9 @@ __all__ = [
     "RESULT_DELIVERED",
     "CHECKPOINT_WRITTEN",
     "UNKNOWN_RESULT",
+    "CONFIG_SAMPLED",
+    "PROMOTION_DECISION",
+    "ALERT",
 ]
 
 logger = logging.getLogger("hpbandster_tpu.obs")
@@ -71,6 +74,13 @@ RPC_RETRY = "rpc_retry"
 RESULT_DELIVERED = "result_delivered"
 CHECKPOINT_WRITTEN = "checkpoint_written"
 UNKNOWN_RESULT = "unknown_result"
+#: optimizer decision audit records (obs/audit.py): why a config was
+#: sampled, and what a rung promotion decided — the journal's view of the
+#: ALGORITHM, not the infrastructure
+CONFIG_SAMPLED = "config_sampled"
+PROMOTION_DECISION = "promotion_decision"
+#: streaming anomaly detector verdicts (obs/anomaly.py)
+ALERT = "alert"
 
 #: the core vocabulary (docs/observability.md "Event schema"). emit() also
 #: accepts names outside this set — subsystems may add their own (span
@@ -79,6 +89,7 @@ EVENT_TYPES = frozenset({
     JOB_SUBMITTED, JOB_STARTED, JOB_FINISHED, JOB_FAILED,
     WORKER_DISCOVERED, WORKER_DROPPED, BRACKET_PROMOTION, KDE_REFIT,
     RPC_RETRY, RESULT_DELIVERED, CHECKPOINT_WRITTEN, UNKNOWN_RESULT,
+    CONFIG_SAMPLED, PROMOTION_DECISION, ALERT,
 })
 
 #: process-wide kill switch (hpbandster_tpu.obs.set_enabled)

@@ -69,18 +69,23 @@ class HealthEndpoint:
         ring: Optional[RingBuffer] = None,
         in_flight: Optional[Callable[[], Any]] = None,
         registry: Optional[MetricsRegistry] = None,
+        anomaly: Optional[Any] = None,
     ):
         self.component = component
         self.identity = dict(identity) if identity is not None else process_identity()
         self._ring = ring
         self._in_flight = in_flight
         self._registry = registry
+        #: optional obs.anomaly.AnomalyDetector whose alert tally rides
+        #: the snapshot (anything with a .snapshot() -> dict works)
+        self._anomaly = anomaly
         self._t0_mono = time.monotonic()
         self._t0_wall = time.time()
 
     def snapshot(self, tail: int = 32) -> Dict[str, Any]:
         """The ``obs_snapshot`` RPC body: identity + uptime + in-flight
-        work + atomic metrics cut + newest ``tail`` ring events."""
+        work + atomic metrics cut (histograms include p50/p95) + a
+        ``latency`` convenience section + newest ``tail`` ring events."""
         reg = self._registry if self._registry is not None else get_metrics()
         in_flight = None
         if self._in_flight is not None:
@@ -89,15 +94,30 @@ class HealthEndpoint:
             except Exception:
                 # introspection must never take the serving process down
                 logger.exception("obs_snapshot in_flight callable failed")
-        return {
+        metrics = reg.snapshot()
+        # the quantile cut `watch --snapshot` renders: latency visibility
+        # with no journal on disk (histogram bounds cap the resolution —
+        # the p50/p95 are bucket upper bounds, conservative by design)
+        latency = {
+            name: {"count": h["count"], "p50": h["p50"], "p95": h["p95"]}
+            for name, h in metrics.get("histograms", {}).items()
+        }
+        out = {
             "component": self.component,
             "identity": self.identity,
             "uptime_s": round(time.monotonic() - self._t0_mono, 3),
             "started_t_wall": self._t0_wall,
             "in_flight": in_flight,
-            "metrics": reg.snapshot(),
+            "metrics": metrics,
+            "latency": latency,
             "ring_tail": _ring_tail(self._ring, tail),
         }
+        if self._anomaly is not None:
+            try:
+                out["alerts"] = self._anomaly.snapshot()
+            except Exception:
+                logger.exception("obs_snapshot anomaly snapshot failed")
+        return out
 
     def register(self, server: Any) -> None:
         """Expose :meth:`snapshot` as the ``obs_snapshot`` RPC method."""

@@ -20,16 +20,17 @@ from __future__ import annotations
 
 import collections
 import json
+import math
 import os
 import socket
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from hpbandster_tpu.obs.events import Event
 
 __all__ = [
     "JsonlJournal", "RingBuffer", "journal_paths", "read_journal",
-    "process_identity",
+    "read_journal_ex", "process_identity",
 ]
 
 
@@ -39,6 +40,25 @@ def _jsonable(x: Any) -> Any:
         return float(x)
     except (TypeError, ValueError):
         return str(x)
+
+
+def _definite(x: Any) -> Any:
+    """Recursively replace non-finite floats with None (the slow path of
+    write_record): a journal line must be STRICT JSON — bare NaN/Infinity
+    (e.g. a diverged run's inf loss inside a promotion_decision's losses
+    list) breaks jq/JS readers of the very post-mortem they exist for."""
+    if isinstance(x, dict):
+        return {k: _definite(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_definite(v) for v in x]
+    if isinstance(x, float):  # np.float64 subclasses float; covered
+        return x if math.isfinite(x) else None
+    if x is None or isinstance(x, (str, int, bool)):
+        return x
+    y = _jsonable(x)
+    if isinstance(y, float) and not math.isfinite(y):
+        return None
+    return y
 
 
 def event_to_record(ev: Event) -> Dict[str, Any]:
@@ -120,7 +140,14 @@ class JsonlJournal:
             record = dict(record)
             for k, v in self.static_fields.items():
                 record.setdefault(k, v)
-        line = json.dumps(record, default=_jsonable) + "\n"
+        try:
+            line = json.dumps(record, default=_jsonable, allow_nan=False) + "\n"
+        except ValueError:
+            # non-finite float somewhere in the record: sanitize to null
+            # (strict-JSON guarantee; the fast path above stays one dumps)
+            line = json.dumps(
+                _definite(record), default=_jsonable, allow_nan=False
+            ) + "\n"
         data = line.encode("utf-8")
         with self._lock:
             if self._fh is None:
@@ -174,13 +201,17 @@ def journal_paths(path: str) -> List[str]:
     return out
 
 
-def read_journal(path: str) -> List[Dict[str, Any]]:
-    """All records of a (possibly rotated) journal, oldest first.
+def read_journal_ex(path: str) -> "Tuple[List[Dict[str, Any]], int]":
+    """All records of a (possibly rotated) journal, oldest first, plus
+    the number of unparseable/non-object lines that were skipped.
 
-    Unparseable lines (a crash mid-write on the final line) are skipped,
-    not fatal — a post-mortem reader must survive the crash it documents.
+    Skipping (a crash mid-write tears the final line) is deliberate — a
+    post-mortem reader must survive the crash it documents — but the
+    count is reported so the CLI can WARN instead of silently narrowing
+    the evidence.
     """
     records: List[Dict[str, Any]] = []
+    skipped = 0
     for fn in journal_paths(path):
         with open(fn, "r", encoding="utf-8") as fh:
             for line in fh:
@@ -188,7 +219,17 @@ def read_journal(path: str) -> List[Dict[str, Any]]:
                 if not line:
                     continue
                 try:
-                    records.append(json.loads(line))
+                    rec = json.loads(line)
                 except ValueError:
+                    skipped += 1
                     continue
-    return records
+                if isinstance(rec, dict):
+                    records.append(rec)
+                else:
+                    skipped += 1
+    return records, skipped
+
+
+def read_journal(path: str) -> List[Dict[str, Any]]:
+    """:func:`read_journal_ex` without the skip count."""
+    return read_journal_ex(path)[0]

@@ -35,14 +35,15 @@ import json
 import os
 import sys
 import time
-from typing import Any, Dict, Iterable, List, Optional, Sequence, TextIO
+from typing import Any, Dict, Iterable, List, Optional, Sequence, TextIO, Tuple
 
 from hpbandster_tpu.obs import events as E
-from hpbandster_tpu.obs.journal import read_journal
+from hpbandster_tpu.obs.journal import read_journal_ex
 
 __all__ = [
     "summarize_records", "format_summary", "summarize_path",
-    "read_merged", "trace_timelines", "watch_journal",
+    "read_merged", "read_merged_ex", "trace_timelines", "watch_journal",
+    "watch_snapshot",
 ]
 
 #: journal-record fields -> timeline stage names (the emitting sites:
@@ -84,14 +85,23 @@ def _stats(vals: Iterable[float]) -> Optional[Dict[str, Any]]:
     }
 
 
-def read_merged(paths: Sequence[str]) -> List[Dict[str, Any]]:
+def read_merged_ex(paths: Sequence[str]) -> "Tuple[List[Dict[str, Any]], int]":
     """Records of N journals merged oldest-first by wall clock (the only
-    cross-process ordering available; durations never derive from it)."""
+    cross-process ordering available; durations never derive from it),
+    plus the total count of skipped corrupt/truncated lines."""
     records: List[Dict[str, Any]] = []
+    skipped = 0
     for p in paths:
-        records.extend(read_journal(p))
+        recs, skip = read_journal_ex(p)
+        records.extend(recs)
+        skipped += skip
     records.sort(key=lambda r: r.get("t_wall") if isinstance(r.get("t_wall"), (int, float)) else 0.0)
-    return records
+    return records, skipped
+
+
+def read_merged(paths: Sequence[str]) -> List[Dict[str, Any]]:
+    """:func:`read_merged_ex` without the skip count."""
+    return read_merged_ex(paths)[0]
 
 
 def trace_timelines(records: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -359,6 +369,8 @@ class _WatchState:
         self.workers: set = set()
         self.last_name: Optional[str] = None
         self.last_t_wall: Optional[float] = None
+        self.last_alert: Optional[str] = None
+        self.skipped_lines = 0
         self._seen_job_keys: set = set()
 
     def update(self, rec: Dict[str, Any]) -> None:
@@ -379,6 +391,10 @@ class _WatchState:
         w = rec.get("worker") or rec.get("worker_id")
         if w:
             self.workers.add(str(w))
+        if name == E.ALERT:
+            self.last_alert = (
+                f"{rec.get('rule') or '?'}:{rec.get('subject') or '?'}"
+            )
         self.last_name = name
         tw = rec.get("t_wall")
         if isinstance(tw, (int, float)):
@@ -395,10 +411,18 @@ class _WatchState:
             last = f"{self.last_name} {age:.1f}s ago"
         else:
             last = "-"
+        alerts = c.get(E.ALERT, 0)
+        alert_part = (
+            f" alerts={alerts}({self.last_alert})" if alerts else ""
+        )
+        skip_part = (
+            f" skipped_lines={self.skipped_lines}" if self.skipped_lines else ""
+        )
         return (
             f"events={self.events} submitted={submitted} finished={finished} "
             f"failed={failed} in_flight={in_flight} "
             f"workers={len(self.workers)} last={last}"
+            f"{alert_part}{skip_part}"
         )
 
 
@@ -439,12 +463,86 @@ def watch_journal(
                 if not line:
                     continue
                 try:
-                    state.update(json.loads(line))
+                    rec = json.loads(line)
                 except ValueError:
+                    # torn/corrupt line: counted, never fatal — the tail
+                    # of a crashing run is exactly when watch matters
+                    state.skipped_lines += 1
                     continue
+                if isinstance(rec, dict):
+                    state.update(rec)
+                else:
+                    state.skipped_lines += 1
             status = state.line()
         else:
             status = f"(waiting for {path})"
+        stamp = time.strftime("%H:%M:%S")
+        print(f"[{stamp}] {status}", file=out, flush=True)
+        tick += 1
+        if ticks is not None and tick >= ticks:
+            return 0
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:  # graftlint: disable=swallowed-exception — ^C is the intended way to leave watch
+            return 0
+
+
+def watch_snapshot(
+    uri: str,
+    interval: float = 2.0,
+    ticks: Optional[int] = None,
+    stream: Optional[TextIO] = None,
+) -> int:
+    """Poll a live process's ``obs_snapshot`` health RPC — latency
+    without a journal on disk.
+
+    Renders the snapshot's histogram quantiles (the ``latency`` section
+    :meth:`~hpbandster_tpu.obs.health.HealthEndpoint.snapshot` computes
+    from the metrics registry), the in-flight work, and the anomaly
+    alert tally. An unreachable peer prints a waiting line and keeps
+    polling — the target may simply not be up yet.
+    """
+    # CLI-only import: the obs substrate itself never pulls in the RPC
+    # transport (health.py is deliberately transport-agnostic)
+    from hpbandster_tpu.parallel.rpc import (
+        CommunicationError,
+        RPCError,
+        RPCProxy,
+        parse_uri,
+    )
+
+    out = stream if stream is not None else sys.stdout
+    try:
+        # a malformed URI can never succeed: fail fast as a usage error
+        # instead of looping "waiting" forever on a typo
+        parse_uri(uri)
+    except ValueError as e:
+        print(f"error: invalid --snapshot URI {uri!r}: {e}", file=sys.stderr)
+        return 2
+    tick = 0
+    while True:
+        try:
+            snap = RPCProxy(uri, timeout=max(interval, 2.0)).call("obs_snapshot")
+            up = snap.get("uptime_s")
+            counters = (snap.get("metrics") or {}).get("counters") or {}
+            lat = snap.get("latency") or {}
+            lat_part = " ".join(
+                f"{name}=p50:{v.get('p50'):g}/p95:{v.get('p95'):g}"
+                for name, v in sorted(lat.items())
+                if isinstance(v, dict)
+                and isinstance(v.get("p50"), (int, float))
+                and isinstance(v.get("p95"), (int, float))
+            )
+            alerts = snap.get("alerts") or {}
+            status = (
+                f"{snap.get('component', '?')} up={up}s "
+                f"in_flight={json.dumps(snap.get('in_flight'))} "
+                f"counters={sum(counters.values())} "
+                f"alerts={alerts.get('total', 0)}"
+                + (f" latency: {lat_part}" if lat_part else "")
+            )
+        except (OSError, CommunicationError, RPCError, AttributeError) as e:
+            status = f"(waiting for obs_snapshot at {uri}: {type(e).__name__})"
         stamp = time.strftime("%H:%M:%S")
         print(f"[{stamp}] {status}", file=out, flush=True)
         tick += 1

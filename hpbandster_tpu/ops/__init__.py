@@ -17,5 +17,6 @@ from hpbandster_tpu.ops.kde import (  # noqa: F401
     propose,
     propose_batch,
     propose_batch_seeded,
+    propose_batch_seeded_scored,
     sample_around,
 )

@@ -35,6 +35,7 @@ __all__ = [
     "sample_around",
     "propose",
     "propose_batch",
+    "propose_batch_seeded_scored",
 ]
 
 #: reference clips pdf values at 1e-32 before the ratio (SURVEY.md §3.4)
@@ -264,6 +265,37 @@ def generate_candidates_seeded(
 
 
 @partial(jax.jit, static_argnames=("n", "num_samples"))
+def propose_batch_seeded_scored(
+    seed: jax.Array,
+    good: KDE,
+    bad: KDE,
+    vartypes: jax.Array,
+    cards: jax.Array,
+    n: int,
+    num_samples: int = 64,
+    bandwidth_factor: float = 3.0,
+    min_bandwidth: float = 1e-3,
+) -> Tuple[jax.Array, jax.Array]:
+    """Like :func:`propose_batch` but derives the key batch on-device from
+    a single uint32 seed — one scalar transfer instead of an [n, 2] key
+    upload (matters when the host link is a high-latency tunnel) — and
+    also returns each proposal's winning acquisition score:
+    ``(f32[n, d], f32[n])`` where the score is the selected candidate's
+    ``log l(x) - log g(x)`` (the max over the same score vector the
+    argmax already computed), so the audit trail (``obs/audit.py``)
+    costs one extra [n] fetch, not a different draw."""
+    keys = jax.random.split(jax.random.key(seed), n)
+
+    def one(k):
+        best, _, scores = propose(
+            k, good, bad, vartypes, cards, num_samples, bandwidth_factor,
+            min_bandwidth,
+        )
+        return best, jnp.max(scores)
+
+    return jax.vmap(one)(keys)
+
+
 def propose_batch_seeded(
     seed: jax.Array,
     good: KDE,
@@ -275,15 +307,13 @@ def propose_batch_seeded(
     bandwidth_factor: float = 3.0,
     min_bandwidth: float = 1e-3,
 ) -> jax.Array:
-    """Like :func:`propose_batch` but derives the key batch on-device from a
-    single uint32 seed — one scalar transfer instead of an [n, 2] key upload
-    (matters when the host link is a high-latency tunnel)."""
-    keys = jax.random.split(jax.random.key(seed), n)
-    return jax.vmap(
-        lambda k: propose(
-            k, good, bad, vartypes, cards, num_samples, bandwidth_factor, min_bandwidth
-        )[0]
-    )(keys)
+    """:func:`propose_batch_seeded_scored` without the scores — one
+    proposal body to maintain (the discarded per-proposal max is trivial
+    next to the candidate scoring it reuses)."""
+    return propose_batch_seeded_scored(
+        seed, good, bad, vartypes, cards, n, num_samples, bandwidth_factor,
+        min_bandwidth,
+    )[0]
 
 
 @partial(jax.jit, static_argnames=("num_samples",))

@@ -79,11 +79,9 @@ class BOHB(Master):
         self, iteration: int, iteration_kwargs: Dict[str, Any]
     ) -> SuccessiveHalving:
         plan = hyperband_bracket(iteration, self.min_budget, self.max_budget, self.eta)
-        obs.emit(
-            "bracket_created",
-            iteration=iteration,
-            num_configs=list(plan.num_configs),
-            budgets=list(plan.budgets),
+        obs.emit_bracket_created(
+            iteration, plan.num_configs, plan.budgets,
+            eta=self.eta, random_fraction=self.config.get("random_fraction"),
         )
         return self.iteration_class(
             HPB_iter=iteration,

@@ -60,6 +60,8 @@ class _ReplayIteration(SuccessiveHalving):
     bookkeeping must record those decisions verbatim (they follow the same
     top-k rule, but the device is authoritative)."""
 
+    promotion_rule = "fused_replay"
+
     def __init__(self, *args, promotion_sets: List[set], **kwargs):
         super().__init__(*args, **kwargs)
         self._promotion_sets = promotion_sets
@@ -707,6 +709,13 @@ class FusedBOHB:
         def no_sampler(budget):  # replay adds every config explicitly
             raise RuntimeError("fused replay must not sample fresh configs")
 
+        # journal parity with the Master tiers: the replayed bracket
+        # announces its plan, then its config_sampled/promotion_decision
+        # records flow from the shared BaseIteration bookkeeping below
+        obs.emit_bracket_created(
+            b_i, plan.num_configs, plan.budgets,
+            eta=self.eta, random_fraction=self.random_fraction,
+        )
         it = _ReplayIteration(
             HPB_iter=b_i,
             num_configs=list(plan.num_configs),
@@ -723,6 +732,9 @@ class FusedBOHB:
                 cfg,
                 {
                     "model_based_pick": bool(mb_mask[i]),
+                    # decision detail (KDE budget, l/g score) stayed on
+                    # device; the audit record still attributes the arm
+                    "sample_reason": "fused_sweep",
                     "fused_sweep": True,
                 },
             )
@@ -754,6 +766,17 @@ class FusedBOHB:
                 job.result = None
                 job.exception = f"non-finite loss {loss!r} at budget {budget}"
             job.time_it("finished")
+            # the fused tier's loss-carrying result record — journal
+            # parity with Master.job_callback (no run_s: the evaluation
+            # executed inside a fused device chunk, per-job host timing
+            # would be fiction; sweep_chunk carries the real durations)
+            obs.emit(
+                obs.JOB_FAILED if job.exception is not None else obs.JOB_FINISHED,
+                config_id=list(config_id), budget=budget,
+                # non-finite (NaN-crashed or inf-diverged) -> null: bare
+                # NaN/Infinity is not strict JSON (same rule as the master)
+                loss=float(loss) if np.isfinite(loss) else None,
+            )
             if self.result_logger is not None:
                 self.result_logger(job)
             it.register_result(job)

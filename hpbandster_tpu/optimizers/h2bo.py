@@ -33,6 +33,8 @@ __all__ = ["H2BO", "LCExtrapolationIteration"]
 class LCExtrapolationIteration(BaseIteration):
     """Promote by extrapolated final-budget loss instead of current loss."""
 
+    promotion_rule = "lc_extrapolation"
+
     def __init__(self, *args, lc_model=None, **kwargs):
         super().__init__(*args, **kwargs)
         self.lc_model = lc_model or PowerLawModel()
@@ -58,6 +60,11 @@ class LCExtrapolationIteration(BaseIteration):
         scores = np.where(np.isnan(extrapolated), losses, extrapolated)
         # crashed configs (NaN raw loss) must stay NaN -> never promoted
         scores = np.where(np.isnan(losses), np.nan, scores)
+        # the promotion audit record must show what the decision was
+        # ACTUALLY ranked by — extrapolations, not the raw rung losses
+        self.last_promotion_scores = [
+            None if np.isnan(s) else float(s) for s in scores
+        ]
         k = self.num_configs[self.stage + 1]
         return np.asarray(sh_promotion_mask(scores.astype(np.float32), k))
 
@@ -70,9 +77,14 @@ class H2BO(BOHB):
     def get_next_iteration(
         self, iteration: int, iteration_kwargs: Dict[str, Any]
     ) -> LCExtrapolationIteration:
+        from hpbandster_tpu import obs
         from hpbandster_tpu.ops.bracket import hyperband_bracket
 
         plan = hyperband_bracket(iteration, self.min_budget, self.max_budget, self.eta)
+        obs.emit_bracket_created(
+            iteration, plan.num_configs, plan.budgets,
+            eta=self.eta, random_fraction=self.config.get("random_fraction"),
+        )
         return LCExtrapolationIteration(
             HPB_iter=iteration,
             num_configs=list(plan.num_configs),

@@ -99,6 +99,7 @@ class Dispatcher:
         ping_interval: float = 10.0,
         discover_interval: float = 1.0,
         logger: Optional[logging.Logger] = None,
+        anomaly: Any = None,
     ):
         self.run_id = run_id
         self.nameserver_uri = format_uri(nameserver, nameserver_port)
@@ -124,6 +125,20 @@ class Dispatcher:
         self._new_result_callback: Optional[Callable[[Job], None]] = None
         self._new_worker_callback: Optional[Callable[[int], None]] = None
 
+        #: opt-in streaming anomaly detection (obs/anomaly.py): truthy
+        #: subscribes a detector to the process bus for the run's lifetime
+        #: and surfaces its alert tally in this dispatcher's obs_snapshot
+        #: (pass AnomalyRules to tune thresholds, True for defaults)
+        self.anomaly_detector = None
+        self._anomaly_detach: Optional[Callable[[], None]] = None
+        if anomaly:
+            from hpbandster_tpu.obs.anomaly import AnomalyDetector, AnomalyRules
+
+            self.anomaly_detector = AnomalyDetector(
+                rules=anomaly if isinstance(anomaly, AnomalyRules) else None,
+                bus=obs.get_bus(),
+            )
+
     # --------------------------------------------------------- executor seam
     def start(
         self,
@@ -136,12 +151,15 @@ class Dispatcher:
         self._server = RPCServer(self.host, 0)
         self._server.register("register_result", self._rpc_register_result)
         self._server.register("ping", lambda: "pong")
+        if self.anomaly_detector is not None:
+            self._anomaly_detach = obs.get_bus().subscribe(self.anomaly_detector)
         # fleet health: the dispatcher introspects like any other process
         HealthEndpoint(
             component="dispatcher",
             identity=obs.process_identity(run_id=self.run_id),
             ring=self.dead_letters,
             in_flight=self._health_in_flight,
+            anomaly=self.anomaly_detector,
         ).register(self._server)
         self._server.start()
 
@@ -195,6 +213,9 @@ class Dispatcher:
                 w.shutdown()
         with self._cond:
             self._cond.notify_all()
+        if self._anomaly_detach is not None:
+            self._anomaly_detach()
+            self._anomaly_detach = None
         if self._server is not None:
             self._server.shutdown()
             self._server = None

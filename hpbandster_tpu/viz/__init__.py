@@ -5,6 +5,7 @@ from hpbandster_tpu.viz.plots import (  # noqa: F401
     correlation_across_budgets,
     default_tool_tips,
     finished_runs_over_time,
+    incumbent_trajectory_from_journal,
     interactive_HBS_plot,
     losses_over_time,
 )

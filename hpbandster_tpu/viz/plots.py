@@ -20,6 +20,7 @@ __all__ = [
     "finished_runs_over_time",
     "correlation_across_budgets",
     "interactive_HBS_plot",
+    "incumbent_trajectory_from_journal",
 ]
 
 
@@ -185,6 +186,93 @@ def correlation_across_budgets(result, show: bool = False):
     if show:  # pragma: no cover
         plt.show()
     return fig, ax, corr
+
+
+def incumbent_trajectory_from_journal(
+    journal, log_y: bool = False, show: bool = False,
+):
+    """Incumbent trajectory + model-vs-random attribution from a run
+    journal's audit records (``obs/audit.py``) — no Result object needed.
+
+    ``journal`` is a journal path, a list of paths (merged), or a list of
+    already-read record dicts. Renders the incumbent-loss step curve over
+    run time with each improvement marked by its sampling arm (model-based
+    KDE pick vs random draw), plus every evaluated loss as background
+    scatter — the picture of WHEN the model starts earning its keep.
+    """
+    plt = _require_plt()
+    # the incumbent/arm-attribution join has ONE implementation — the
+    # report's (obs/report.py); this plot only adds the background
+    # scatter and the rendering, so plot markers and report table can
+    # never drift apart
+    from hpbandster_tpu.obs.audit import config_lineage
+    from hpbandster_tpu.obs.report import _finite, _incumbent_trajectory
+    from hpbandster_tpu.obs.summarize import read_merged
+
+    if isinstance(journal, str):
+        records = read_merged([journal])
+    elif journal and isinstance(journal[0], str):
+        records = read_merged(list(journal))
+    else:
+        # pre-read record dicts: apply read_merged's wall-clock ordering
+        # ourselves — the incumbent accumulation assumes time order
+        records = sorted(
+            journal,
+            key=lambda r: r.get("t_wall")
+            if isinstance(r.get("t_wall"), (int, float)) else 0.0,
+        )
+
+    walls = [
+        r["t_wall"] for r in records
+        if isinstance(r.get("t_wall"), (int, float))
+    ]
+    t0 = min(walls) if walls else None
+    rows = _incumbent_trajectory(records, config_lineage(records), t0)
+    pts = []  # background: every finite loss-carrying result
+    for rec in records:
+        if rec.get("event") != "job_finished" or "loss" not in rec:
+            continue
+        loss = _finite(rec.get("loss"))
+        tw = rec.get("t_wall")
+        if loss is None:
+            continue
+        pts.append((
+            float(tw) - t0
+            if isinstance(tw, (int, float)) and t0 is not None else 0.0,
+            loss,
+        ))
+
+    fig, ax = plt.subplots()
+    if pts:
+        times = [p[0] for p in pts]
+        losses = [p[1] for p in pts]
+        ax.scatter(times, losses, s=8, alpha=0.25, color="gray",
+                   label="all evaluations")
+        ax.step(times, np.minimum.accumulate(losses), where="post",
+                color="black", label="incumbent")
+        for arm, color, marker in (
+            (True, "tab:blue", "o"), (False, "tab:orange", "s"),
+            (None, "gray", "x"),
+        ):
+            sel = [
+                r for r in rows
+                if r["model_based"] is arm and r["at_s"] is not None
+            ]
+            if sel:
+                label = {True: "model-based", False: "random",
+                         None: "unattributed"}[arm]
+                ax.scatter(
+                    [r["at_s"] for r in sel], [r["loss"] for r in sel],
+                    color=color, marker=marker, zorder=3, label=label,
+                )
+    if log_y:
+        ax.set_yscale("log")
+    ax.set_xlabel("wall clock time [s]")
+    ax.set_ylabel("loss")
+    ax.legend()
+    if show:  # pragma: no cover
+        plt.show()
+    return fig, ax
 
 
 def interactive_HBS_plot(

@@ -22,3 +22,9 @@ def forged_clock(bus):
 def timed_region():
     with span("compute", pid=4242):  # BAD
         pass
+
+
+def forged_audit(cid):
+    # an audit record is a journal record like any other: fabricating its
+    # trace breaks the lineage join exactly like fabricating a job's
+    obs.emit("config_sampled", config_id=cid, trace_id="feedface")  # BAD

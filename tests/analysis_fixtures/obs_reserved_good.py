@@ -23,6 +23,13 @@ def timed_region():
         pass
 
 
+def audited_sample(cid, info):
+    # audit records go through the dedicated emitters and inherit the
+    # trace stamp like every other event — no reserved kwargs in sight
+    obs.emit_config_sampled(cid, 1.0, info)
+    obs.emit("config_sampled", config_id=cid, budget=1.0, lg_score=2.5)
+
+
 def configured_identity(path):
     # host/pid enter records via static fields, once, at configure time
     journal = JsonlJournal(path, static_fields=process_identity(worker_id="w0"))

@@ -1,0 +1,679 @@
+"""Optimizer decision audit, anomaly detection, and the report CLI.
+
+Contracts pinned here (docs/observability.md "Optimizer decision audit"
+/ "Anomaly detection" / "Run reports"):
+
+* every audit record survives journal rotation and multi-journal merge
+  byte-faithfully (property-style round-trips over varied field shapes);
+* the optimizer tiers actually emit them — batched BOHB and the fused
+  sweep both journal config_sampled / promotion_decision records that
+  reconcile with their Result objects;
+* the anomaly rules fire on the failure shapes they advertise, offline
+  scans are deterministic, and a live detector feeds bus + counters;
+* ``report`` output is byte-identical across invocations over the same
+  journal, and the CLI errors cleanly on missing files and warns (not
+  raises) on corrupt lines.
+"""
+
+import io
+import json
+
+import pytest
+
+from hpbandster_tpu import obs
+from hpbandster_tpu.obs.__main__ import main as obs_main
+from hpbandster_tpu.obs.anomaly import AnomalyDetector, AnomalyRules, scan_records
+from hpbandster_tpu.obs.audit import config_lineage
+from hpbandster_tpu.obs.journal import read_journal_ex
+from hpbandster_tpu.obs.report import build_report, format_report
+from hpbandster_tpu.obs.summarize import read_merged, read_merged_ex
+
+
+def _sampling_record(i):
+    """Varied, deterministic config_sampled field shapes for round-trips."""
+    model = i % 3 != 0
+    fields = {
+        "config_id": [i // 9, i % 3, i % 9],
+        "budget": float(3 ** (i % 4)),
+        "model_based_pick": model,
+        "sample_reason": "model" if model else "random_fraction",
+    }
+    if model:
+        fields.update(
+            model_budget=float(3 ** (i % 3)),
+            n_points_in_model=8 + i,
+            lg_score=round(-5.0 + i * 0.37, 6),
+            bandwidth_factor=3.0,
+        )
+    return fields
+
+
+def _promotion_record(it):
+    ids = [[it, 0, k] for k in range(9)]
+    losses = [round((k * 37 % 11) + it * 0.5, 6) for k in range(9)]
+    losses[4] = None  # one crashed candidate
+    order = sorted(
+        (l, k) for k, l in enumerate(losses) if l is not None
+    )
+    promoted = [False] * 9
+    for _, k in order[:3]:
+        promoted[k] = True
+    return dict(
+        iteration=it, rung=it % 2, budget=float(3 ** (it % 2)),
+        next_budget=float(3 ** (it % 2 + 1)),
+        config_ids=ids, losses=losses, promoted=promoted,
+        rule="successive_halving",
+    )
+
+
+class TestAuditRoundTrip:
+    def test_records_survive_rotation_and_merge(self, tmp_path):
+        """Property: every audit record emitted through a rotating journal
+        (tiny max_bytes -> many rotations) and a 2-journal merge comes
+        back with every field intact."""
+        paths = [str(tmp_path / f"j{k}.jsonl") for k in range(2)]
+        emitted = {"config_sampled": [], "promotion_decision": []}
+        for k, path in enumerate(paths):
+            journal = obs.JsonlJournal(path, max_bytes=700, max_files=50)
+            detach = obs.get_bus().subscribe(journal)
+            try:
+                for i in range(k * 40, k * 40 + 40):
+                    f = _sampling_record(i)
+                    obs.emit_config_sampled(f["config_id"], f["budget"], f)
+                    emitted["config_sampled"].append(f)
+                for it in range(k * 5, k * 5 + 5):
+                    p = _promotion_record(it)
+                    obs.emit_promotion_decision(**p)
+                    emitted["promotion_decision"].append(p)
+            finally:
+                detach()
+                journal.close()
+            assert journal.rotations > 0, "rotation boundary never exercised"
+
+        records, skipped = read_merged_ex(paths)
+        assert skipped == 0
+        got_samples = [r for r in records if r["event"] == "config_sampled"]
+        got_promos = [r for r in records if r["event"] == "promotion_decision"]
+        assert len(got_samples) == 80 and len(got_promos) == 10
+
+        by_id = {tuple(r["config_id"]): r for r in got_samples}
+        for f in emitted["config_sampled"]:
+            rec = by_id[tuple(f["config_id"])]
+            for key, v in f.items():
+                assert rec[key] == v, (key, rec)
+        by_iter = {r["iteration"]: r for r in got_promos}
+        for p in emitted["promotion_decision"]:
+            rec = by_iter[p["iteration"]]
+            assert rec["config_ids"] == p["config_ids"]
+            assert rec["losses"] == p["losses"]
+            assert rec["promoted"] == p["promoted"]
+            assert rec["n_promoted"] == sum(p["promoted"])
+            survivors = sorted(
+                l for l, pr in zip(p["losses"], p["promoted"])
+                if pr and l is not None
+            )
+            assert rec["survivor_losses"] == survivors
+            assert rec["cut_threshold"] == max(survivors)
+        # merge is wall-clock ordered
+        walls = [r["t_wall"] for r in records]
+        assert walls == sorted(walls)
+
+    def test_lineage_joins_samples_results_and_rungs(self):
+        recs = [
+            {"event": "config_sampled", "t_wall": 1.0, "config_id": [0, 0, 1],
+             "budget": 1.0, "model_based_pick": True, "lg_score": 2.5},
+            {"event": "job_finished", "t_wall": 2.0, "config_id": [0, 0, 1],
+             "budget": 1.0, "loss": 7.5, "run_s": 0.1},
+            {"event": "job_finished", "t_wall": 3.0, "config_id": [0, 0, 1],
+             "budget": 3.0, "loss": 6.0, "run_s": 0.1},
+            # worker-side twin (no loss): must not clobber the result
+            {"event": "job_finished", "t_wall": 3.1, "config_id": [0, 0, 1],
+             "budget": 3.0, "compute_s": 0.09},
+            {"event": "promotion_decision", "t_wall": 2.5, "iteration": 0,
+             "rung": 0, "budget": 1.0, "next_budget": 3.0,
+             "config_ids": [[0, 0, 1], [0, 0, 2]], "losses": [7.5, 9.0],
+             "promoted": [True, False]},
+        ]
+        lin = config_lineage(recs)
+        s = lin[(0, 0, 1)]
+        assert s["sampled"]["model_based_pick"] is True
+        assert s["sampled"]["lg_score"] == 2.5
+        assert s["results"] == {1.0: 7.5, 3.0: 6.0}
+        assert s["rungs"] == [(0, 0, 1.0, True)]
+        assert lin[(0, 0, 2)]["rungs"] == [(0, 0, 1.0, False)]
+
+
+class TestOptimizerEmission:
+    @pytest.fixture()
+    def journal(self, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        handle = obs.configure(journal_path=path)
+        yield path
+        handle.close()
+
+    def test_batched_bohb_emits_linked_audit_records(self, journal, tmp_path):
+        from hpbandster_tpu.optimizers import BOHB
+        from hpbandster_tpu.parallel import BatchedExecutor, VmapBackend
+
+        from tests.toys import branin_from_vector, branin_space
+
+        cs = branin_space(seed=0)
+        executor = BatchedExecutor(
+            VmapBackend(branin_from_vector), cs, parallel_brackets=1
+        )
+        opt = BOHB(
+            configspace=cs, run_id="audit-e2e", executor=executor,
+            min_budget=1, max_budget=9, eta=3, seed=0,
+        )
+        res = opt.run(n_iterations=3)
+        opt.shutdown()
+
+        records = read_merged([journal])
+        samples = [r for r in records if r["event"] == "config_sampled"]
+        promos = [r for r in records if r["event"] == "promotion_decision"]
+        # one birth record per config the Result knows about, ids matching
+        assert {tuple(r["config_id"]) for r in samples} == set(
+            res.get_id2config_mapping()
+        )
+        model_recs = [r for r in samples if r.get("model_based_pick")]
+        assert model_recs, "model never engaged in 3 brackets?"
+        for r in model_recs:
+            assert r["sample_reason"] == "model"
+            assert r["model_budget"] >= 1.0
+            assert r["n_points_in_model"] > 0
+            assert isinstance(r["lg_score"], float)
+        for r in samples:
+            if not r.get("model_based_pick"):
+                assert r["sample_reason"] in ("no_model", "random_fraction")
+        # promotion records reconcile with the bracket_promotion events
+        brackets = [r for r in records if r["event"] == "bracket_promotion"]
+        assert len(promos) == len(brackets)
+        for p in promos:
+            assert p["rule"] == "successive_halving"
+            assert p["n_candidates"] == len(p["config_ids"]) == len(p["losses"])
+            assert sum(p["promoted"]) == p["n_promoted"]
+            survivors = [
+                l for l, pr in zip(p["losses"], p["promoted"]) if pr
+            ]
+            assert p["cut_threshold"] == max(survivors)
+        # the loss-carrying master funnel records exist for the lineage join
+        finished = [
+            r for r in records if r["event"] == "job_finished" and "loss" in r
+        ]
+        assert len(finished) == len(res.get_all_runs())
+
+    def test_fused_sweep_emits_audit_records(self, journal):
+        from hpbandster_tpu.optimizers.fused_bohb import FusedBOHB
+
+        from tests.toys import branin_from_vector, branin_space
+
+        opt = FusedBOHB(
+            configspace=branin_space(seed=1), eval_fn=branin_from_vector,
+            run_id="audit-fused", min_budget=1, max_budget=9, eta=3, seed=1,
+        )
+        res = opt.run(n_iterations=2)
+        opt.shutdown()
+
+        records = read_merged([journal])
+        samples = [r for r in records if r["event"] == "config_sampled"]
+        promos = [r for r in records if r["event"] == "promotion_decision"]
+        assert {tuple(r["config_id"]) for r in samples} == set(
+            res.get_id2config_mapping()
+        )
+        assert all(r["sample_reason"] == "fused_sweep" for r in samples)
+        assert promos and all(r["rule"] == "fused_replay" for r in promos)
+        finished = [
+            r for r in records
+            if r["event"] in ("job_finished", "job_failed") and "loss" in r
+        ]
+        assert len(finished) == len(res.get_all_runs())
+        # the replay's records must replay the device's promotions exactly
+        for p in promos:
+            promoted_ids = {
+                tuple(cid) for cid, pr in zip(p["config_ids"], p["promoted"])
+                if pr
+            }
+            datum_ids = {
+                cid for cid, d in opt.iterations[p["iteration"]].data.items()
+                if p["next_budget"] in d.results
+            }
+            assert promoted_ids == datum_ids
+
+    def test_lc_extrapolation_scores_ride_the_record(self):
+        """H2BO's promotion record must show the extrapolated scores the
+        decision actually ranked by, not just the raw rung losses."""
+        from hpbandster_tpu.core.job import Job
+        from hpbandster_tpu.optimizers.h2bo import LCExtrapolationIteration
+
+        captured = []
+        detach = obs.get_bus().subscribe(
+            lambda ev: captured.append(ev) if ev.name == "promotion_decision" else None
+        )
+        try:
+            k = [0]
+
+            def sampler(budget):
+                k[0] += 1
+                return {"x": float(k[0])}, {"model_based_pick": False}
+
+            it = LCExtrapolationIteration(
+                HPB_iter=0, num_configs=[3, 1], budgets=[1.0, 3.0],
+                config_sampler=sampler,
+            )
+            for loss in (5.0, 3.0, 4.0):
+                cid, cfg, budget = it.get_next_run()
+                job = Job(cid, config=cfg, budget=budget)
+                job.result = {"loss": loss}
+                it.register_result(job, skip_sanity_checks=True)
+            assert it.process_results()
+        finally:
+            detach()
+        (ev,) = captured
+        assert ev.fields["rule"] == "lc_extrapolation"
+        assert len(ev.fields["scores"]) == 3
+        assert ev.fields["losses"] == [5.0, 3.0, 4.0]
+
+
+class TestAnomalyDetector:
+    def _result(self, i, run_s=0.1, loss=1.0, event="job_finished"):
+        return {
+            "event": event, "t_wall": 100.0 + i, "t_mono": float(i),
+            "config_id": [0, 0, i], "budget": 1.0,
+            "run_s": run_s, "loss": loss,
+        }
+
+    def test_straggler_fires_over_rolling_p95(self):
+        rules = AnomalyRules(straggler_min_samples=20, straggler_floor_s=0.05)
+        det = AnomalyDetector(rules=rules)
+        for i in range(30):
+            assert det.process(self._result(i, run_s=0.1)) == []
+        fired = det.process(self._result(31, run_s=1.0))
+        assert [a["rule"] for a in fired] == ["straggler"]
+        assert fired[0]["subject"] == "job_finished.run_s@1"
+        assert fired[0]["value_s"] == 1.0
+        # cooldown suppresses the immediate repeat
+        assert det.process(self._result(32, run_s=1.0)) == []
+
+    def test_straggler_windows_never_pool_budgets(self):
+        """A budget-9 evaluation is ~9x a budget-1 one BY DESIGN: rung
+        transitions in a healthy multi-fidelity sweep must not alert."""
+        det = AnomalyDetector(rules=AnomalyRules(straggler_min_samples=10))
+        for i in range(30):
+            assert det.process(self._result(i, run_s=0.2)) == []
+        big = dict(self._result(31, run_s=1.8))
+        big["budget"] = 9.0
+        assert det.process(big) == []
+
+    def test_straggler_floor_ignores_micro_stages(self):
+        det = AnomalyDetector(rules=AnomalyRules(straggler_min_samples=5))
+        for i in range(20):
+            det.process(self._result(i, run_s=0.001))
+        # a 10ms blip over a 1ms baseline is "10x" of nothing: no alert
+        assert det.process(self._result(21, run_s=0.01)) == []
+        # a genuinely huge outlier over the same micro baseline still fires
+        fired = det.process(self._result(22, run_s=10.0))
+        assert [a["rule"] for a in fired] == ["straggler"]
+
+    def test_worker_flapping(self):
+        det = AnomalyDetector(
+            rules=AnomalyRules(flap_threshold=3, flap_window_s=60.0)
+        )
+        fired = []
+        for i in range(3):
+            fired += det.process({
+                "event": "worker_dropped", "t_wall": 100.0 + i,
+                "worker": "w0", "reason": "unreachable",
+            })
+        assert [a["rule"] for a in fired] == ["worker_flapping"]
+        assert fired[0]["subject"] == "w0" and fired[0]["drops"] == 3
+        # three DIFFERENT workers: routine churn, no alert
+        det2 = AnomalyDetector(
+            rules=AnomalyRules(flap_threshold=3, flap_window_s=60.0)
+        )
+        for i in range(3):
+            assert det2.process({
+                "event": "worker_dropped", "t_wall": 100.0 + i,
+                "worker": f"w{i}",
+            }) == []
+
+    def test_nan_burst(self):
+        det = AnomalyDetector(
+            rules=AnomalyRules(nan_burst_threshold=3, nan_burst_window=8)
+        )
+        fired = []
+        # a mix of failure shapes: an exception-failure, a NaN-diverged
+        # result journaled as loss=null (the strict-JSON convention), and
+        # a raw inf from a foreign journal — all must count as bad
+        fired += det.process(self._result(0, loss=None, event="job_failed"))
+        fired += det.process(self._result(1, loss=None))
+        fired += det.process(self._result(2, loss=float("inf")))
+        assert [a["rule"] for a in fired] == ["nan_burst"]
+        assert fired[0]["bad_results"] == 3
+
+    def test_kde_refit_stall(self):
+        det = AnomalyDetector(rules=AnomalyRules(kde_stall_results=10))
+        # no refit seen yet: random-search phase, no stall possible
+        for i in range(20):
+            assert det.process(self._result(i)) == []
+        det.process({"event": "kde_refit", "t_wall": 200.0, "budget": 1.0})
+        fired = []
+        for i in range(11):
+            fired += det.process(self._result(100 + i))
+        assert [a["rule"] for a in fired] == ["kde_refit_stall"]
+
+    def test_offline_scan_is_deterministic(self):
+        recs = [self._result(i, run_s=0.1) for i in range(40)]
+        recs.append(self._result(50, run_s=2.0))
+        a = scan_records(recs)
+        b = scan_records(recs)
+        assert a == b and a, "same journal must scan identically"
+
+    def test_live_detector_emits_alert_events_and_counters(self):
+        bus = obs.EventBus()
+        reg = obs.MetricsRegistry()
+        det = AnomalyDetector(
+            rules=AnomalyRules(nan_burst_threshold=2, nan_burst_window=4),
+            bus=bus, registry=reg,
+        )
+        seen = []
+        d1 = bus.subscribe(det)
+        d2 = bus.subscribe(lambda ev: seen.append(ev.name))
+        try:
+            for i in range(2):
+                bus.emit(
+                    "job_failed", config_id=[0, 0, i], budget=1.0,
+                    run_s=0.1, loss=None,
+                )
+        finally:
+            d1()
+            d2()
+        assert "alert" in seen
+        snap = reg.snapshot()["counters"]
+        assert snap["anomaly.alerts"] == 1
+        assert snap["anomaly.alerts.nan_burst"] == 1
+        assert det.snapshot()["by_rule"] == {"nan_burst": 1}
+        # the detector saw its own alert event and ignored it (no storm)
+        assert sum(det.alert_counts.values()) == 1
+
+
+def _synthetic_journal(path, n_configs=30, alerts=False):
+    """Deterministic hand-written journal exercising every report section."""
+    recs = []
+    t = 1000.0
+    recs.append({
+        "event": "bracket_created", "t_wall": t, "iteration": 0,
+        "num_configs": [n_configs, 3], "budgets": [1.0, 3.0],
+    })
+    losses = []
+    for i in range(n_configs):
+        t += 1.0
+        model = i % 2 == 0
+        loss = float((i * 7) % 13) + (0.25 if model else 0.5)
+        losses.append(loss)
+        recs.append({
+            "event": "config_sampled", "t_wall": t, "config_id": [0, 0, i],
+            "budget": 1.0, "model_based_pick": model,
+            "sample_reason": "model" if model else "random_fraction",
+            "lg_score": 1.0 + i,
+        })
+        recs.append({
+            "event": "job_finished", "t_wall": t + 0.5,
+            "config_id": [0, 0, i], "budget": 1.0, "worker": "w0",
+            "run_s": 0.4, "loss": loss,
+        })
+    order = sorted(range(n_configs), key=lambda i: losses[i])
+    promoted = [i in order[:3] for i in range(n_configs)]
+    recs.append({
+        "event": "promotion_decision", "t_wall": t + 1.0, "iteration": 0,
+        "rung": 0, "budget": 1.0, "next_budget": 3.0,
+        "rule": "successive_halving",
+        "config_ids": [[0, 0, i] for i in range(n_configs)],
+        "losses": losses, "promoted": promoted,
+        "n_promoted": 3, "n_candidates": n_configs,
+        "cut_threshold": max(l for l, p in zip(losses, promoted) if p),
+        "survivor_losses": sorted(
+            l for l, p in zip(losses, promoted) if p
+        ),
+    })
+    for rank, i in enumerate(order[:3]):
+        recs.append({
+            "event": "job_finished", "t_wall": t + 2.0 + rank,
+            "config_id": [0, 0, i], "budget": 3.0, "worker": "w0",
+            "run_s": 0.4, "loss": losses[i] * 0.9 + rank * 0.01,
+        })
+    if alerts:
+        recs.append({
+            "event": "alert", "t_wall": t + 9.0, "rule": "straggler",
+            "subject": "job_finished.run_s", "source_event": "job_finished",
+        })
+    with open(path, "w", encoding="utf-8") as fh:
+        for r in recs:
+            fh.write(json.dumps(r) + "\n")
+    return recs
+
+
+class TestReport:
+    def test_report_sections_and_content(self, tmp_path):
+        path = str(tmp_path / "synth.jsonl")
+        _synthetic_journal(path, alerts=True)
+        rep = build_report(read_merged([path]))
+        # incumbent trajectory is non-increasing and arm-attributed
+        traj = rep["incumbent_trajectory"]
+        assert traj and all(
+            a["loss"] > b["loss"] for a, b in zip(traj, traj[1:])
+        )
+        assert {row["model_based"] for row in traj} <= {True, False}
+        # model vs random at budget 1: all 30 attributed
+        b1 = rep["model_vs_random"]["budgets"]["1"]
+        assert b1["n_model"] == 15 and b1["n_random"] == 15
+        assert 0.0 <= b1["model_win_rate"] <= 1.0
+        # promoted configs all finished at 3.0 -> regret computable
+        (decision,) = rep["promotion_regret"]["decisions"]
+        assert decision["evaluated_promoted"] == 3
+        assert decision["rank1_regret"] is not None
+        assert decision["inversions"] is not None
+        # bracket table reconciles planned vs sampled
+        (bracket,) = rep["brackets"]
+        assert bracket["planned_configs"] == [30, 3]
+        assert bracket["sampled"] == 30 and bracket["model_based"] == 15
+        assert bracket["evaluations"] == 33
+        # recorded alert wins over offline scan
+        assert rep["alerts"]["source"] == "journal"
+        assert rep["alerts"]["by_rule"] == {"straggler": 1}
+
+    def test_regret_ranks_by_rule_scores_when_present(self):
+        """H2BO-style records: the regret table must judge the ranking
+        the rule actually used (extrapolation scores), not raw losses."""
+        recs = [
+            {"event": "promotion_decision", "t_wall": 1.0, "iteration": 0,
+             "rung": 0, "budget": 1.0, "next_budget": 3.0,
+             "rule": "lc_extrapolation",
+             "config_ids": [[0, 0, 0], [0, 0, 1]],
+             "losses": [5.0, 10.0],       # raw-loss top pick: config 0
+             "scores": [10.0, 3.0],       # rule's ACTUAL top pick: config 1
+             "promoted": [True, True]},
+            {"event": "job_finished", "t_wall": 2.0, "config_id": [0, 0, 0],
+             "budget": 3.0, "run_s": 0.1, "loss": 4.0},
+            {"event": "job_finished", "t_wall": 2.1, "config_id": [0, 0, 1],
+             "budget": 3.0, "run_s": 0.1, "loss": 9.0},
+        ]
+        (decision,) = build_report(recs)["promotion_regret"]["decisions"]
+        # score-top config 1 finished at 9.0; best promoted finished 4.0
+        assert decision["rank1_regret"] == pytest.approx(5.0)
+        assert decision["rank_held"] is False
+
+    def test_report_offline_scan_when_no_recorded_alerts(self, tmp_path):
+        path = str(tmp_path / "synth.jsonl")
+        _synthetic_journal(path, alerts=False)
+        rep = build_report(read_merged([path]))
+        assert rep["alerts"]["source"] == "offline_scan"
+
+    def test_report_cli_byte_identical_across_runs(self, tmp_path, capsys):
+        """Acceptance criterion: deterministic report output."""
+        path = str(tmp_path / "synth.jsonl")
+        _synthetic_journal(path, alerts=True)
+        assert obs_main(["report", path]) == 0
+        first = capsys.readouterr().out
+        assert obs_main(["report", path]) == 0
+        second = capsys.readouterr().out
+        assert first.encode("utf-8") == second.encode("utf-8")
+        for section in (
+            "incumbent trajectory", "model vs random", "promotion regret",
+            "bracket utilization", "alert digest",
+        ):
+            assert section in first, f"missing section {section!r}"
+        # --json is valid, sorted, and equally deterministic
+        assert obs_main(["report", path, "--json"]) == 0
+        as_json = json.loads(capsys.readouterr().out)
+        assert as_json["brackets"][0]["sampled"] == 30
+
+    def test_report_over_live_run_journal_is_deterministic(
+        self, tmp_path, capsys
+    ):
+        """e2e: a real (batched BOHB) run's journal reports identically
+        across two invocations — the CLI never mixes in wall-clock 'now'."""
+        from hpbandster_tpu.optimizers import BOHB
+        from hpbandster_tpu.parallel import BatchedExecutor, VmapBackend
+
+        from tests.toys import branin_from_vector, branin_space
+
+        path = str(tmp_path / "run.jsonl")
+        handle = obs.configure(journal_path=path)
+        try:
+            cs = branin_space(seed=7)
+            opt = BOHB(
+                configspace=cs, run_id="report-e2e",
+                executor=BatchedExecutor(
+                    VmapBackend(branin_from_vector), cs, parallel_brackets=1
+                ),
+                min_budget=1, max_budget=9, eta=3, seed=7,
+            )
+            opt.run(n_iterations=2)
+            opt.shutdown()
+        finally:
+            handle.close()
+        assert obs_main(["report", path]) == 0
+        first = capsys.readouterr().out
+        assert obs_main(["report", path]) == 0
+        assert first == capsys.readouterr().out
+        assert "model vs random" in first
+
+    def test_missing_journal_is_usage_error(self, capsys):
+        assert obs_main(["report", "/nonexistent/journal.jsonl"]) == 2
+        assert "do not exist" in capsys.readouterr().err
+
+    def test_corrupt_lines_warn_but_do_not_fail(self, tmp_path, capsys):
+        path = str(tmp_path / "torn.jsonl")
+        _synthetic_journal(path)
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write('{"event": "job_fini')  # torn mid-crash
+            fh.write("\nnot json at all\n")
+        records, skipped = read_journal_ex(path)
+        assert skipped == 2
+        assert obs_main(["report", path]) == 0
+        err = capsys.readouterr().err
+        assert "skipped 2 corrupt/truncated" in err
+        assert obs_main(["summarize", path]) == 0
+        assert "skipped 2 corrupt/truncated" in capsys.readouterr().err
+
+
+class TestHealthLatencyAndWatch:
+    def test_snapshot_carries_latency_quantiles_and_alerts(self):
+        reg = obs.MetricsRegistry()
+        h = reg.histogram("worker.compute_s", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.05, 0.5, 5.0):
+            h.observe(v)
+        det = AnomalyDetector(rules=AnomalyRules())
+        det.alert_counts["straggler"] = 2
+        ep = obs.HealthEndpoint("worker", registry=reg, anomaly=det)
+        snap = ep.snapshot()
+        lat = snap["latency"]["worker.compute_s"]
+        assert lat["count"] == 4
+        assert lat["p50"] == 0.1 and lat["p95"] == 10.0
+        assert snap["alerts"]["by_rule"] == {"straggler": 2}
+        assert json.dumps(snap, default=str)  # RPC-serializable
+
+    def test_watch_shows_alerts_and_skipped_lines(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(json.dumps({
+                "event": "alert", "t_wall": 1.0, "rule": "nan_burst",
+                "subject": "losses",
+            }) + "\n")
+            fh.write("garbage line\n")
+        out = io.StringIO()
+        from hpbandster_tpu.obs.summarize import watch_journal
+
+        assert watch_journal(path, interval=0.01, ticks=1, stream=out) == 0
+        line = out.getvalue()
+        assert "alerts=1(nan_burst:losses)" in line
+        assert "skipped_lines=1" in line
+
+    def test_watch_snapshot_polls_health_rpc(self):
+        from hpbandster_tpu.obs.summarize import watch_snapshot
+        from hpbandster_tpu.parallel.rpc import RPCServer
+
+        reg = obs.MetricsRegistry()
+        reg.histogram("worker.compute_s").observe(0.05)
+        server = RPCServer("127.0.0.1", 0)
+        obs.HealthEndpoint("worker", registry=reg).register(server)
+        server.start()
+        try:
+            out = io.StringIO()
+            assert watch_snapshot(
+                server.uri, interval=0.01, ticks=2, stream=out
+            ) == 0
+            text = out.getvalue()
+            assert "worker" in text
+            assert "worker.compute_s=p50:0.05/p95:0.05" in text
+        finally:
+            server.shutdown()
+
+    def test_watch_snapshot_waits_for_unreachable_peer(self):
+        from hpbandster_tpu.obs.summarize import watch_snapshot
+
+        out = io.StringIO()
+        assert watch_snapshot(
+            "127.0.0.1:1", interval=0.01, ticks=1, stream=out
+        ) == 0
+        assert "waiting for obs_snapshot" in out.getvalue()
+
+    def test_watch_snapshot_malformed_uri_is_usage_error(self, capsys):
+        """A typo'd URI can never succeed — fail fast, don't loop
+        'waiting' forever."""
+        from hpbandster_tpu.obs.summarize import watch_snapshot
+
+        assert watch_snapshot("localhost", interval=0.01, ticks=1) == 2
+        assert "invalid --snapshot URI" in capsys.readouterr().err
+
+    def test_watch_needs_journal_or_snapshot(self, capsys):
+        assert obs_main(["watch"]) == 2
+        assert "journal path or --snapshot" in capsys.readouterr().err
+
+    def test_watch_rejects_journal_plus_snapshot(self, capsys):
+        assert obs_main(["watch", "j.jsonl", "--snapshot", "h:1"]) == 2
+        assert "not both" in capsys.readouterr().err
+
+    def test_configure_anomaly_attaches_and_detaches(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        handle = obs.configure(
+            journal_path=path,
+            anomaly=AnomalyRules(nan_burst_threshold=2, nan_burst_window=4),
+        )
+        try:
+            assert handle.anomaly is not None
+            for i in range(2):
+                obs.emit(
+                    "job_failed", config_id=[0, 0, i], budget=1.0,
+                    run_s=0.1, loss=None,
+                )
+        finally:
+            handle.close()
+        recs = obs.read_journal(path)
+        alerts = [r for r in recs if r["event"] == "alert"]
+        assert len(alerts) == 1 and alerts[0]["rule"] == "nan_burst"
+        assert handle.anomaly.alert_counts == {"nan_burst": 1}
+        # detached: further results must not reach the detector
+        obs.emit("job_failed", config_id=[0, 0, 9], budget=1.0,
+                 run_s=0.1, loss=None)
+        assert handle.anomaly.alert_counts == {"nan_burst": 1}

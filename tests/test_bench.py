@@ -291,6 +291,10 @@ def _stub_tiers(monkeypatch, calls):
         bench, "bench_obs_overhead",
         lambda **kw: calls.setdefault("obs_overhead", True)
         and {"overhead_pct": 0.1})
+    monkeypatch.setattr(
+        bench, "bench_report_100k",
+        lambda **kw: calls.setdefault("report_100k", True)
+        and {"n_events": 100000, "events_per_s": 1, "deterministic": True})
 
 
 class TestFallbackContract:
@@ -442,7 +446,7 @@ class TestTierSelection:
         assert set(bench.TIER_ORDER) == {
             "cnn", "cnn_wide", "pallas", "resnet", "transformer",
             "fused10k", "chunked10k", "chunked_compile", "fused", "rpc",
-            "batched", "teacher", "obs_overhead",
+            "batched", "teacher", "obs_overhead", "report_100k",
         }
 
 

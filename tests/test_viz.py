@@ -14,6 +14,7 @@ from hpbandster_tpu.viz import (
     correlation_across_budgets,
     default_tool_tips,
     finished_runs_over_time,
+    incumbent_trajectory_from_journal,
     interactive_HBS_plot,
     losses_over_time,
 )
@@ -61,3 +62,31 @@ def test_interactive_plot_and_tooltips(result):
     assert set(tips) == set(result.get_id2config_mapping())
     fig, ax = interactive_HBS_plot(lcs, tool_tip_strings=tips)
     assert ax.lines
+
+
+def test_incumbent_trajectory_from_journal(tmp_path):
+    """Audit-sourced trajectory plot: journal in, step curve + arm-
+    attributed improvement markers out (no Result object involved)."""
+    import json
+
+    path = str(tmp_path / "j.jsonl")
+    with open(path, "w", encoding="utf-8") as fh:
+        for i, (loss, model) in enumerate(
+            [(9.0, False), (5.0, True), (7.0, False), (2.0, True)]
+        ):
+            fh.write(json.dumps({
+                "event": "config_sampled", "t_wall": 10.0 + i,
+                "config_id": [0, 0, i], "budget": 1.0,
+                "model_based_pick": model,
+            }) + "\n")
+            fh.write(json.dumps({
+                "event": "job_finished", "t_wall": 10.5 + i,
+                "config_id": [0, 0, i], "budget": 1.0,
+                "run_s": 0.1, "loss": loss,
+            }) + "\n")
+    fig, ax = incumbent_trajectory_from_journal(path)
+    assert ax.lines, "incumbent step curve missing"
+    # background scatter + at least model/random improvement markers
+    assert len(ax.collections) >= 3
+    labels = {t.get_text() for t in ax.get_legend().get_texts()}
+    assert {"incumbent", "model-based", "random"} <= labels
