@@ -7,6 +7,7 @@ decorated with ``@register`` and importing it below (see
 
 from hpbandster_tpu.analysis.rules import (  # noqa: F401
     exceptions,
+    jit_loop,
     jit_purity,
     locks,
     markers,

@@ -1,0 +1,153 @@
+"""jit-in-loop — a jit wrapper constructed inside a loop body.
+
+``jax.jit`` (and :func:`~hpbandster_tpu.obs.runtime.tracked_jit`, which
+wraps it) returns a callable with its OWN compile cache. Constructing one
+inside a ``for``/``while`` body or a comprehension builds a fresh,
+empty-cached wrapper every iteration, so every call compiles again —
+the textbook recompile storm the runtime telemetry tier
+(``obs/runtime.py``, the ``recompile_storm`` anomaly rule) exists to
+catch at runtime. This rule catches it at review time instead: the fix
+is hoisting the ``jit`` out of the loop (or caching the wrapper, as
+``ops/fused.py`` and ``parallel/backends.py`` do with their process-wide
+LRU caches).
+
+Flagged in per-iteration positions — a loop body/``orelse``, a
+``while`` test, a comprehension's element/``if``s/2nd+ generator
+iterables:
+
+* direct construction: ``jax.jit(f)``, ``jit(f)``, ``jax.pmap(f)``,
+  ``tracked_jit(f)`` (aliased imports resolved);
+* jitted lambdas: ``jax.jit(lambda x: ...)`` is the same construction
+  wearing lambda clothes, and a ``lambda: jax.jit(f)(x)`` body defers
+  the construction to each call — both flagged.
+
+NOT flagged:
+
+* ``jax.vmap`` — a transform, not a compile boundary; vmapping inside a
+  traced body is ordinary staging;
+* once-evaluated positions: a ``for`` statement's iterable and a
+  comprehension's FIRST generator iterable (``[y for y in jit(f)(x)]``
+  constructs once);
+* calls inside a ``def`` nested within the loop — a factory defined per
+  iteration may be called once; the jit site is judged where it runs;
+* CALLING an already-constructed jitted function in a loop — that is
+  the supported hot path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from hpbandster_tpu.analysis.core import Finding, Rule, SourceModule, register
+from hpbandster_tpu.analysis.rules._util import ImportMap, import_map_for
+
+#: wrappers whose construction owns a compile cache (vmap deliberately
+#: absent: it transforms, it does not compile)
+_COMPILING_WRAPPERS = {
+    "jax.jit",
+    "jit",
+    "jax.pmap",
+    "pmap",
+    "tracked_jit",
+    "hpbandster_tpu.obs.tracked_jit",
+    "hpbandster_tpu.obs.runtime.tracked_jit",
+}
+
+_LOOPS = (ast.For, ast.AsyncFor, ast.While)
+_COMPREHENSIONS = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+def _compiling_callee(node: ast.Call, imports: ImportMap) -> str:
+    """The resolved wrapper name when ``node`` constructs a jit wrapper,
+    else ''. ``functools.partial(jax.jit, ...)`` counts: the partial is a
+    per-iteration wrapper factory with the same empty-cache economics."""
+    resolved = imports.resolve(node.func) or ""
+    if resolved in _COMPILING_WRAPPERS:
+        return resolved
+    if resolved in ("functools.partial", "partial"):
+        for arg in node.args:
+            inner = imports.resolve(arg) or ""
+            if inner in _COMPILING_WRAPPERS:
+                return inner
+    return ""
+
+
+def _walk_skipping_defs(root: ast.AST):
+    """Walk ``root`` without descending into nested function definitions
+    (a factory defined in the loop constructs only when called — judged
+    at its own call site). Lambdas ARE descended into: their bodies run
+    per call of a per-iteration object."""
+    if isinstance(root, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            stack.append(child)
+
+
+@register
+class JitInLoopRule(Rule):
+    name = "jit-in-loop"
+    description = (
+        "jax.jit / tracked_jit / pmap constructed inside a loop or "
+        "comprehension body — every iteration builds a fresh wrapper with "
+        "an empty compile cache (guaranteed recompiles); hoist or cache it"
+    )
+
+    def check(self, module: SourceModule) -> List[Finding]:
+        # sound prefilter: a flaggable call requires one of these tokens
+        if not any(t in module.text for t in ("jit", "pmap")):
+            return []
+        imports = import_map_for(module)
+        findings: List[Finding] = []
+        flagged: Set[int] = set()
+        for node in module.walk():
+            if isinstance(node, _LOOPS):
+                bodies = list(node.body) + list(node.orelse)
+                if isinstance(node, ast.While):
+                    # the test expression runs every iteration too
+                    bodies.append(node.test)
+            elif isinstance(node, _COMPREHENSIONS):
+                # per-iteration positions only: the element expression,
+                # every `if`, and the 2nd+ generators' iterables. The
+                # FIRST generator's iterable is evaluated exactly once —
+                # a jit constructed there is a hoisted construction, not
+                # a storm.
+                bodies = (
+                    [node.key, node.value] if isinstance(node, ast.DictComp)
+                    else [node.elt]
+                )
+                for gi, gen in enumerate(node.generators):
+                    bodies.extend(gen.ifs)
+                    if gi > 0:
+                        bodies.append(gen.iter)
+            else:
+                continue
+            for body in bodies:
+                for sub in _walk_skipping_defs(body):
+                    if not isinstance(sub, ast.Call) or id(sub) in flagged:
+                        continue
+                    wrapper = _compiling_callee(sub, imports)
+                    if not wrapper:
+                        continue
+                    flagged.add(id(sub))
+                    where = (
+                        "comprehension"
+                        if isinstance(node, _COMPREHENSIONS) else "loop"
+                    )
+                    findings.append(
+                        self.finding(
+                            module, sub,
+                            f"{wrapper}(...) constructed inside a {where} "
+                            "body builds a fresh wrapper (empty compile "
+                            "cache) every iteration — guaranteed "
+                            "recompiles; hoist the jit out of the loop or "
+                            "reuse a cached wrapper",
+                        )
+                    )
+        return findings

@@ -41,6 +41,11 @@ _JIT_WRAPPERS = {
     "jit",
     "pmap",
     "vmap",
+    # obs.runtime.tracked_jit is jax.jit plus compile telemetry — a body
+    # it wraps is traced exactly like a jit-decorated one
+    "tracked_jit",
+    "hpbandster_tpu.obs.tracked_jit",
+    "hpbandster_tpu.obs.runtime.tracked_jit",
 }
 
 _CASTS = {"float", "int", "bool", "complex"}

@@ -78,13 +78,14 @@ class JaxSuccessiveHalving(SuccessiveHalving):
     def _advance_to_next_stage(
         self, config_ids: List[ConfigId], losses: np.ndarray
     ) -> np.ndarray:
-        import jax
         import jax.numpy as jnp
 
-        from hpbandster_tpu.ops.bracket import sh_promotion_mask
+        from hpbandster_tpu.ops.bracket import sh_promotion_mask_compiled
 
         if JaxSuccessiveHalving._jitted is None:
-            JaxSuccessiveHalving._jitted = jax.jit(sh_promotion_mask)
+            # tracked_jit: the promotion kernel's compile lands in the
+            # same xla_compile ledger as the fused brackets
+            JaxSuccessiveHalving._jitted = sh_promotion_mask_compiled()
         k = self.num_configs[self.stage + 1]
         mask = JaxSuccessiveHalving._jitted(
             jnp.asarray(losses, jnp.float32), jnp.asarray(k, jnp.int32)

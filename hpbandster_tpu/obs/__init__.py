@@ -22,8 +22,16 @@ emit into (see docs/observability.md):
   ``config_sampled`` / ``promotion_decision`` records (why BOHB sampled
   a config, what a rung promotion decided) + :func:`config_lineage`;
 * :mod:`~hpbandster_tpu.obs.anomaly` — streaming anomaly detection
-  (stragglers, flapping workers, NaN bursts, KDE-refit stalls) emitting
-  ``alert`` events + counters;
+  (stragglers, flapping workers, NaN bursts, KDE-refit stalls,
+  recompile storms) emitting ``alert`` events + counters;
+* :mod:`~hpbandster_tpu.obs.runtime` — XLA runtime telemetry: the
+  :func:`tracked_jit` compile ledger (``xla_compile`` events, per-fn
+  recompile counters), the periodic :class:`DeviceSampler` memory /
+  live-buffer gauges, and :func:`note_transfer` host<->device counters;
+* :mod:`~hpbandster_tpu.obs.export` — the Prometheus-compatible
+  exporter: strict text exposition rendering of any registry snapshot,
+  a round-trip parser, the ``metrics_text`` health-RPC mount, and the
+  ``python -m hpbandster_tpu.obs export`` HTTP bridge;
 * ``python -m hpbandster_tpu.obs summarize <journal> [<journal> ...]`` —
   per-stage latency percentiles, worker utilization, failure tallies, and
   merged cross-host per-trace timelines; ``report`` renders the
@@ -82,6 +90,7 @@ from hpbandster_tpu.obs.events import (  # noqa: F401
     UNKNOWN_RESULT,
     WORKER_DISCOVERED,
     WORKER_DROPPED,
+    XLA_COMPILE,
     Event,
     EventBus,
     emit,
@@ -89,6 +98,11 @@ from hpbandster_tpu.obs.events import (  # noqa: F401
     make_event,
     span,
     use_jax_annotations,
+)
+from hpbandster_tpu.obs.export import (  # noqa: F401
+    parse_prometheus_text,
+    render_registry,
+    render_snapshot,
 )
 from hpbandster_tpu.obs.health import (  # noqa: F401
     HealthEndpoint,
@@ -106,6 +120,15 @@ from hpbandster_tpu.obs.metrics import (  # noqa: F401
     Histogram,
     MetricsRegistry,
     get_metrics,
+)
+from hpbandster_tpu.obs.runtime import (  # noqa: F401
+    CompileTracker,
+    DeviceSampler,
+    get_compile_tracker,
+    note_transfer,
+    runtime_snapshot,
+    start_device_sampler,
+    tracked_jit,
 )
 from hpbandster_tpu.obs.trace import (  # noqa: F401
     TraceContext,
@@ -127,12 +150,16 @@ __all__ = [
     "AnomalyDetector", "AnomalyRules", "scan_records",
     "AUDIT_EVENTS", "config_lineage", "emit_bracket_created",
     "emit_config_sampled", "emit_promotion_decision",
+    "CompileTracker", "DeviceSampler", "get_compile_tracker",
+    "note_transfer", "runtime_snapshot", "start_device_sampler",
+    "tracked_jit",
+    "render_snapshot", "render_registry", "parse_prometheus_text",
     "configure", "set_enabled", "enabled",
     "EVENT_TYPES", "JOB_SUBMITTED", "JOB_STARTED", "JOB_FINISHED",
     "JOB_FAILED", "WORKER_DISCOVERED", "WORKER_DROPPED",
     "BRACKET_PROMOTION", "KDE_REFIT", "RPC_RETRY", "RESULT_DELIVERED",
     "CHECKPOINT_WRITTEN", "UNKNOWN_RESULT",
-    "CONFIG_SAMPLED", "PROMOTION_DECISION", "ALERT",
+    "CONFIG_SAMPLED", "PROMOTION_DECISION", "ALERT", "XLA_COMPILE",
 ]
 
 
@@ -152,17 +179,25 @@ class ObsHandle:
 
     def __init__(self, detachers: List[Callable[[], None]],
                  journal: Optional[JsonlJournal], ring: Optional[RingBuffer],
-                 anomaly: Optional[AnomalyDetector] = None):
+                 anomaly: Optional[AnomalyDetector] = None,
+                 sampler: Optional[DeviceSampler] = None):
         self._detachers = detachers
         self.journal = journal
         self.ring = ring
         self.anomaly = anomaly
+        self.sampler = sampler
 
     def close(self) -> None:
         """Detach every sink and close the journal file (idempotent)."""
         for detach in self._detachers:
             detach()
         self._detachers = []
+        if self.sampler is not None:
+            from hpbandster_tpu.obs.runtime import _clear_device_sampler
+
+            self.sampler.stop()
+            _clear_device_sampler(self.sampler)
+            self.sampler = None
         if self.journal is not None:
             self.journal.close()
 
@@ -181,6 +216,7 @@ def configure(
     identity: Union[bool, Dict[str, Any], None] = None,
     bus: Optional[EventBus] = None,
     anomaly: Union[bool, AnomalyRules, None] = None,
+    device_sampler: Union[bool, float, None] = None,
 ) -> ObsHandle:
     """Attach the standard sinks to ``bus`` (default: the process bus).
 
@@ -193,8 +229,12 @@ def configure(
     ``anomaly`` attaches a streaming :class:`AnomalyDetector` (``True``
     for default :class:`AnomalyRules`, or pass tuned rules); its ``alert``
     events land in the same journal and its tally is on the handle as
-    ``handle.anomaly``. Returns an :class:`ObsHandle` — close it to
-    detach (tests and multi-run processes must, or sinks accumulate)."""
+    ``handle.anomaly``. ``device_sampler`` starts the periodic per-device
+    memory / live-buffer gauge sampler (``True`` for the default 10 s
+    cadence, or a number of seconds) — only in processes that run device
+    work, since the first sample initializes the jax backend. Returns an
+    :class:`ObsHandle` — close it to detach (tests and multi-run
+    processes must, or sinks accumulate)."""
     bus = bus if bus is not None else get_bus()
     detachers: List[Callable[[], None]] = []
     journal = None
@@ -220,4 +260,9 @@ def configure(
             bus=bus,
         )
         detachers.append(bus.subscribe(detector))
-    return ObsHandle(detachers, journal, ring, detector)
+    sampler = None
+    if device_sampler:
+        sampler = start_device_sampler(
+            interval_s=10.0 if device_sampler is True else float(device_sampler)
+        )
+    return ObsHandle(detachers, journal, ring, detector, sampler)

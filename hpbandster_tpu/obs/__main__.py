@@ -12,7 +12,14 @@
 * ``watch <journal> [--interval S] [--ticks N]`` — tail a live journal,
   one status line per tick; runs until ^C unless ``--ticks`` bounds it.
   ``watch --snapshot <uri>`` polls a live process's ``obs_snapshot``
-  health RPC instead — latency quantiles with no journal on disk.
+  health RPC instead — latency quantiles, compile counts, and device
+  memory with no journal on disk.
+* ``export --port N [--snapshot <uri>] [--host H]`` — standalone
+  Prometheus exporter (``obs/export.py``): serves ``GET /metrics`` in
+  the strict text exposition format, rendering this process's registry
+  or, with ``--snapshot``, bridging a fleet peer's ``obs_snapshot`` RPC
+  per scrape. ``export --once`` prints one exposition to stdout and
+  exits (the curl-equivalent for pipelines and tests).
 
 Corrupt/truncated JSONL lines are skipped with a counted stderr warning,
 never fatal (a post-mortem reader must survive the crash it documents).
@@ -62,6 +69,69 @@ def _read_checked(paths: List[str]) -> Optional[list]:
             file=sys.stderr,
         )
     return records
+
+
+def run_export(
+    port: int,
+    host: str = "127.0.0.1",
+    snapshot_uri: Optional[str] = None,
+    once: bool = False,
+) -> int:
+    """The ``export`` subcommand body (separated so tests drive it)."""
+    from hpbandster_tpu.obs.export import (
+        ExporterServer,
+        render_registry,
+        snapshot_fetcher,
+    )
+
+    if snapshot_uri is not None:
+        from hpbandster_tpu.parallel.rpc import parse_uri
+
+        try:
+            # a malformed URI can never succeed: fail fast as usage error
+            parse_uri(snapshot_uri)
+        except ValueError as e:
+            print(
+                f"error: invalid --snapshot URI {snapshot_uri!r}: {e}",
+                file=sys.stderr,
+            )
+            return 2
+        fetch = snapshot_fetcher(snapshot_uri)
+    else:
+        fetch = render_registry
+    if once:
+        try:
+            sys.stdout.write(fetch())
+        except Exception as e:
+            print(f"error: scrape failed: {e}", file=sys.stderr)
+            return 1
+        return 0
+    try:
+        # positional: the obs-reserved-fields rule reserves `host=` kwargs
+        # on obs-resolving calls for the identity stamp; this is a bind
+        # address
+        server = ExporterServer(port, fetch, host)
+    except OSError as e:
+        # port in use / privileged port / bad bind address: the CLI
+        # contract is a clear message + exit 2, never a raw traceback
+        print(
+            f"error: cannot bind exporter to {host}:{port}: {e}",
+            file=sys.stderr,
+        )
+        return 2
+    print(
+        f"serving /metrics on http://{host}:{server.port} "
+        + (f"(bridging obs_snapshot at {snapshot_uri})" if snapshot_uri
+           else "(local registry)"),
+        file=sys.stderr,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:  # graftlint: disable=swallowed-exception — ^C is the intended way to stop the exporter
+        pass
+    finally:
+        server.close()
+    return 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -116,7 +186,36 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--ticks", type=int, default=None,
         help="stop after N ticks (default: run until ^C)",
     )
+    p_exp = sub.add_parser(
+        "export",
+        help="Prometheus exporter: serve GET /metrics in the strict text "
+        "exposition format (see docs/observability.md 'Scraping the fleet')",
+    )
+    p_exp.add_argument(
+        "--port", type=int, default=9090,
+        help="HTTP port to serve /metrics on (default 9090)",
+    )
+    p_exp.add_argument(
+        "--host", default="127.0.0.1",
+        help="bind address (default 127.0.0.1; use 0.0.0.0 to expose)",
+    )
+    p_exp.add_argument(
+        "--snapshot", metavar="URI", default=None,
+        help="bridge mode: per scrape, poll obs_snapshot on this RPC "
+        "endpoint (host:port) and export ITS metrics instead of this "
+        "process's registry",
+    )
+    p_exp.add_argument(
+        "--once", action="store_true",
+        help="print one exposition to stdout and exit (no HTTP server)",
+    )
     args = parser.parse_args(argv)
+
+    if args.command == "export":
+        return run_export(
+            port=args.port, host=args.host, snapshot_uri=args.snapshot,
+            once=args.once,
+        )
 
     if args.command == "watch":
         if args.snapshot is not None:

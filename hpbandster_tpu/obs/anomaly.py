@@ -26,6 +26,17 @@ docs/observability.md "Alert rules"):
   last ``kde_refit`` while a model exists: the optimizer has silently
   degraded to random search (e.g. every new result lands on a budget
   whose fit keeps failing the min-points gate).
+* **recompile_storm** — one function's ``xla_compile`` events
+  (``obs/runtime.py``'s ``tracked_jit``) arriving
+  ``recompile_threshold`` times within ``recompile_window_s``. A compile
+  per fresh bracket shape is BOHB-normal — the default threshold clears
+  a healthy sweep's legitimate compile set (one per bracket shape, one
+  per batch-pad size); the same function compiling past it means shapes
+  are churning (a jit constructed in a loop, an unpadded batch) and XLA
+  is eating the wall-clock the fused paths were supposed to save.
+  Subjects key per fn (``tracked_jit`` events carry no budget); a
+  foreign journal whose ``xla_compile`` records DO carry a ``budget``
+  field gets per-(fn, budget) windows like the straggler rule.
 
 The detector never raises into the bus (rule state is all stdlib), never
 reacts to its own ``alert`` events, and rate-limits per (rule, subject)
@@ -84,6 +95,15 @@ class AnomalyRules:
     #: kde_refit_stall: results since the last refit (0 disables)
     kde_stall_results: int = 64
 
+    #: recompile_storm: this many xla_compile events for one fn subject
+    #: within the window (0 disables; records carrying a budget field —
+    #: foreign journals — key per (fn, budget)). The default clears a
+    #: healthy sweep's LEGITIMATE compile set — one compile per bracket
+    #: shape (max_SH_iter = 4 shapes at budgets 1..81) and per log2
+    #: batch-pad size — while a loop-constructed wrapper blows past it
+    recompile_threshold: int = 6
+    recompile_window_s: float = 600.0
+
     #: per-(rule, subject) re-alert suppression
     cooldown_s: float = 60.0
 
@@ -126,6 +146,7 @@ class AnomalyDetector:
         )
         self._results_since_refit = 0
         self._refit_seen = False
+        self._compile_times: Dict[str, Deque[float]] = {}
         self._last_alert: Dict[Tuple[str, str], float] = {}
 
     # ------------------------------------------------------------- plumbing
@@ -294,6 +315,36 @@ class AnomalyDetector:
         elif name == E.KDE_REFIT:
             self._refit_seen = True
             self._results_since_refit = 0
+
+        # --- recompile storm: one function's tracked_jit boundary keeps
+        # compiling. Subjects key per fn (tracked_jit events carry no
+        # budget; a foreign record that does gets (fn, budget) windows
+        # like the straggler rule): a bounded compile set — one per
+        # bracket shape / pad size — stays under the threshold by
+        # design; the SAME subject churning past it is the incident.
+        if name == E.XLA_COMPILE and r.recompile_threshold > 0:
+            fn = str(rec.get("fn") or "?")
+            budget = rec.get("budget")
+            subject = fn + (
+                f"@{budget:g}" if isinstance(budget, (int, float)) else ""
+            )
+            tw = rec.get("t_wall")
+            tw = float(tw) if isinstance(tw, (int, float)) else 0.0
+            times = self._compile_times.setdefault(
+                subject,
+                collections.deque(maxlen=max(int(r.recompile_threshold), 1) * 4),
+            )
+            times.append(tw)
+            recent = [t for t in times if tw - t <= r.recompile_window_s]
+            if len(recent) >= r.recompile_threshold:
+                a = self._fire(
+                    rec, "recompile_storm", subject,
+                    compiles=len(recent), window_s=r.recompile_window_s,
+                    compile_s=rec.get("compile_s"),
+                    signature=rec.get("signature"),
+                )
+                if a:
+                    fired.append(a)
 
         return fired
 

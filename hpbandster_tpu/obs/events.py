@@ -57,6 +57,7 @@ __all__ = [
     "CONFIG_SAMPLED",
     "PROMOTION_DECISION",
     "ALERT",
+    "XLA_COMPILE",
 ]
 
 logger = logging.getLogger("hpbandster_tpu.obs")
@@ -81,6 +82,10 @@ CONFIG_SAMPLED = "config_sampled"
 PROMOTION_DECISION = "promotion_decision"
 #: streaming anomaly detector verdicts (obs/anomaly.py)
 ALERT = "alert"
+#: XLA runtime telemetry (obs/runtime.py): one record per fresh
+#: compilation a ``tracked_jit`` boundary observed — fn name, abstract
+#: shape signature, compile seconds, per-function recompile count
+XLA_COMPILE = "xla_compile"
 
 #: the core vocabulary (docs/observability.md "Event schema"). emit() also
 #: accepts names outside this set — subsystems may add their own (span
@@ -89,7 +94,7 @@ EVENT_TYPES = frozenset({
     JOB_SUBMITTED, JOB_STARTED, JOB_FINISHED, JOB_FAILED,
     WORKER_DISCOVERED, WORKER_DROPPED, BRACKET_PROMOTION, KDE_REFIT,
     RPC_RETRY, RESULT_DELIVERED, CHECKPOINT_WRITTEN, UNKNOWN_RESULT,
-    CONFIG_SAMPLED, PROMOTION_DECISION, ALERT,
+    CONFIG_SAMPLED, PROMOTION_DECISION, ALERT, XLA_COMPILE,
 })
 
 #: process-wide kill switch (hpbandster_tpu.obs.set_enabled)

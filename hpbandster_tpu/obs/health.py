@@ -110,6 +110,7 @@ class HealthEndpoint:
             "in_flight": in_flight,
             "metrics": metrics,
             "latency": latency,
+            "runtime": self._runtime_section(),
             "ring_tail": _ring_tail(self._ring, tail),
         }
         if self._anomaly is not None:
@@ -119,9 +120,32 @@ class HealthEndpoint:
                 logger.exception("obs_snapshot anomaly snapshot failed")
         return out
 
+    def _runtime_section(self) -> Dict[str, Any]:
+        """The XLA-runtime tier of the snapshot: compile ledger + newest
+        device census (obs/runtime.py). Never initializes a jax backend."""
+        from hpbandster_tpu.obs.runtime import runtime_snapshot
+
+        try:
+            return runtime_snapshot()
+        except Exception:
+            # introspection must never take the serving process down
+            logger.exception("obs_snapshot runtime section failed")
+            return {"compile": None, "devices": None}
+
+    def metrics_text(self) -> str:
+        """The Prometheus text exposition of this process's registry —
+        the same atomic cut :meth:`snapshot` serializes as JSON, in the
+        format a standard scraper ingests (obs/export.py)."""
+        from hpbandster_tpu.obs.export import render_registry
+
+        return render_registry(self._registry)
+
     def register(self, server: Any) -> None:
-        """Expose :meth:`snapshot` as the ``obs_snapshot`` RPC method."""
+        """Expose :meth:`snapshot` as the ``obs_snapshot`` RPC method and
+        :meth:`metrics_text` as ``metrics_text`` — every fleet process is
+        scrapeable through its existing health port."""
         server.register("obs_snapshot", self.snapshot)
+        server.register("metrics_text", self.metrics_text)
 
 
 def install_crash_dump(

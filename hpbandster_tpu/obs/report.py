@@ -20,6 +20,9 @@ latencies, utilization, failures), ``report`` answers "how did the
   ladder analysis (Li et al., JMLR 2017) made from the audit trail;
 * **bracket utilization** — per iteration: planned vs sampled configs,
   model-based share, completed/crashed evaluations, promotions per rung;
+* **runtime** — compile economics from ``xla_compile`` records
+  (``obs/runtime.py``): total compiles, compile seconds, their share of
+  the run's wall-clock window, and the top recompiling functions;
 * **alert digest** — the anomaly detector's verdicts: recorded ``alert``
   events when a live detector ran, otherwise a deterministic offline
   replay of the same rules (``obs.anomaly.scan_records``).
@@ -40,6 +43,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from hpbandster_tpu.obs import events as E
 from hpbandster_tpu.obs.anomaly import scan_records
 from hpbandster_tpu.obs.audit import config_key, config_lineage
+from hpbandster_tpu.obs.runtime import compile_stats_from_records
 
 __all__ = ["build_report", "format_report"]
 
@@ -370,6 +374,11 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "model_vs_random": _model_vs_random(lineages),
         "promotion_regret": _promotion_regret(records, lineages),
         "brackets": _brackets(records, lineages),
+        # compile economics: a healthy shape-stable sweep compiles each
+        # function once; a climbing count here is the journal-side echo
+        # of the live recompile_storm rule (one shared aggregation with
+        # the summarize CLI — the two views of one journal must agree)
+        "runtime": compile_stats_from_records(records, window),
         "alerts": _alert_digest(records, t0),
     }
 
@@ -465,6 +474,28 @@ def format_report(rep: Dict[str, Any]) -> str:
             )
     else:
         lines.append("  (no bracket records in this journal)")
+
+    rt = rep.get("runtime") or {}
+    lines += ["", "xla runtime:"]
+    if rt.get("compiles"):
+        share = rt.get("compile_share_of_wall")
+        lines.append(
+            f"  {rt['compiles']} compiles, {_fmt(rt['compile_s'])}s compile time"
+            + (
+                f" ({_fmt(round(100 * share, 2))}% of run wall-clock)"
+                if share is not None else ""
+            )
+        )
+        lines.append(
+            f"  {'fn':<32} {'compiles':>9} {'recompiles':>11} {'seconds':>10}"
+        )
+        for row in rt.get("top_recompilers") or []:
+            lines.append(
+                f"  {row['fn']:<32} {row['compiles']:>9} "
+                f"{row['recompiles']:>11} {_fmt(row['compile_s']):>10}"
+            )
+    else:
+        lines.append("  (no xla_compile records in this journal)")
 
     al = rep["alerts"]
     lines += [

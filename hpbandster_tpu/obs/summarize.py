@@ -13,6 +13,9 @@ run's shape:
   over the journal's wall-clock window, with jobs/failures tallied;
 * **failure tallies** — failed jobs, RPC retries, dropped workers,
   dead-lettered unknown results;
+* **xla runtime** — compile count / seconds from ``xla_compile`` records
+  (``obs/runtime.py``), the compile-time share of the journal's
+  wall-clock window, and the top recompiling functions;
 * **per-trace timelines** — records sharing a ``trace_id`` (one job's
   round-trip, see ``obs/trace.py``) joined across journals into a
   queue-wait -> dispatch -> compute -> delivery stage breakdown, with the
@@ -256,12 +259,19 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     for sname in sorted(spans):
         stages[sname] = _stats(spans[sname])
 
+    # one shared aggregation with the report CLI (obs/runtime.py) — the
+    # two views of the same journal must agree on compile economics
+    from hpbandster_tpu.obs.runtime import compile_stats_from_records
+
+    runtime = compile_stats_from_records(records, window_s)
+
     return {
         "events_total": sum(counts.values()),
         "window_s": round(window_s, 3),
         "event_counts": dict(sorted(counts.items())),
         "stage_latency_s": stages,
         "worker_utilization": utilization,
+        "runtime": runtime,
         "failures": {
             "jobs_failed": counts.get(E.JOB_FAILED, 0),
             "rpc_retries": counts.get(E.RPC_RETRY, 0),
@@ -301,6 +311,22 @@ def format_summary(s: Dict[str, Any]) -> str:
         )
     if not s["worker_utilization"]:
         lines.append("  (no worker-attributed jobs in this journal)")
+    rt = s.get("runtime") or {}
+    if rt.get("compiles"):
+        lines.append("")
+        share = rt.get("compile_share_of_wall")
+        lines.append(
+            "xla runtime: %d compiles, %.3fs compile time%s"
+            % (
+                rt["compiles"], rt["compile_s"],
+                f" ({100 * share:.1f}% of wall)" if share is not None else "",
+            )
+        )
+        for row in rt.get("top_recompilers") or []:
+            lines.append(
+                f"  {row['fn']}: {row['compiles']} compiles, "
+                f"{row['compile_s']:.3f}s"
+            )
     lines.append("")
     f = s["failures"]
     lines.append(
@@ -415,6 +441,8 @@ class _WatchState:
         alert_part = (
             f" alerts={alerts}({self.last_alert})" if alerts else ""
         )
+        compiles = c.get(E.XLA_COMPILE, 0)
+        compile_part = f" compiles={compiles}" if compiles else ""
         skip_part = (
             f" skipped_lines={self.skipped_lines}" if self.skipped_lines else ""
         )
@@ -422,7 +450,7 @@ class _WatchState:
             f"events={self.events} submitted={submitted} finished={finished} "
             f"failed={failed} in_flight={in_flight} "
             f"workers={len(self.workers)} last={last}"
-            f"{alert_part}{skip_part}"
+            f"{compile_part}{alert_part}{skip_part}"
         )
 
 
@@ -487,6 +515,46 @@ def watch_journal(
             return 0
 
 
+def _fmt_bytes(n: Any) -> str:
+    if not isinstance(n, (int, float)):
+        return "?"
+    v = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024.0 or unit == "TiB":
+            return f"{v:.0f}{unit}" if unit == "B" else f"{v:.1f}{unit}"
+        v /= 1024.0
+    return f"{v:.1f}TiB"
+
+
+def _snapshot_runtime_part(snap: Dict[str, Any]) -> str:
+    """Render a health snapshot's ``runtime`` section for one watch line:
+    compile tally + per-device memory/live-buffer gauges."""
+    rt = snap.get("runtime")
+    if not isinstance(rt, dict):
+        return ""
+    parts: List[str] = []
+    compile_led = rt.get("compile") or {}
+    if compile_led.get("total_compiles"):
+        parts.append(
+            "compiles=%d(%.1fs)"
+            % (compile_led["total_compiles"], compile_led.get("total_compile_s") or 0.0)
+        )
+    devices = (rt.get("devices") or {}).get("devices") if rt.get("devices") else None
+    if isinstance(devices, dict):
+        for i in sorted(devices, key=lambda k: (len(k), k)):
+            d = devices[i]
+            if not isinstance(d, dict):
+                continue
+            if "bytes_in_use" in d and "bytes_limit" in d:
+                mem = f"{_fmt_bytes(d['bytes_in_use'])}/{_fmt_bytes(d['bytes_limit'])}"
+            elif "bytes_in_use" in d:
+                mem = _fmt_bytes(d["bytes_in_use"])
+            else:
+                mem = f"{d.get('live_buffers', '?')}buf"
+            parts.append(f"dev{i}={mem}")
+    return (" runtime: " + " ".join(parts)) if parts else ""
+
+
 def watch_snapshot(
     uri: str,
     interval: float = 2.0,
@@ -540,6 +608,7 @@ def watch_snapshot(
                 f"counters={sum(counters.values())} "
                 f"alerts={alerts.get('total', 0)}"
                 + (f" latency: {lat_part}" if lat_part else "")
+                + _snapshot_runtime_part(snap)
             )
         except (OSError, CommunicationError, RPCError, AttributeError) as e:
             status = f"(waiting for obs_snapshot at {uri}: {type(e).__name__})"

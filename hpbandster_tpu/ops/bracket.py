@@ -24,6 +24,7 @@ __all__ = [
     "hyperband_bracket",
     "hyperband_schedule",
     "sh_promotion_mask",
+    "sh_promotion_mask_compiled",
     "sh_promotion_mask_np",
     "sh_resample_mask",
     "power_law_extrapolate",
@@ -117,6 +118,28 @@ def sh_promotion_mask(losses: jax.Array, k) -> jax.Array:
     clean = jnp.where(jnp.isnan(losses), jnp.inf, losses)
     ranks = jnp.argsort(jnp.argsort(clean))
     return ranks < k
+
+
+#: process-wide compiled promotion kernel, built on first use. A plain
+#: module-level jit would be fine for dispatch, but routing it through
+#: ``obs.runtime.tracked_jit`` journals its (single, scalar-k) compile in
+#: the same ledger as the fused brackets — the whole on-device promotion
+#: tier accounted under one vocabulary.
+_PROMOTION_JIT = None
+
+
+def sh_promotion_mask_compiled():
+    """The tracked-jit compilation of :func:`sh_promotion_mask` (lazy,
+    one per process). ``k`` stays a traced scalar so every bracket width
+    shares one executable — callers pass it as an ``i32`` array."""
+    global _PROMOTION_JIT
+    if _PROMOTION_JIT is None:
+        from hpbandster_tpu.obs.runtime import tracked_jit
+
+        _PROMOTION_JIT = tracked_jit(
+            sh_promotion_mask, name="sh_promotion_mask"
+        )
+    return _PROMOTION_JIT
 
 
 def sh_promotion_mask_np(losses: np.ndarray, k) -> np.ndarray:

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hpbandster_tpu.obs.runtime import note_transfer, tracked_jit
+
 __all__ = ["fused_sh_bracket", "make_fused_bracket_fn"]
 
 #: crashed (NaN) losses map here for ranking: behind any real loss, ahead of
@@ -120,6 +122,7 @@ def _unpack_stages(packed, num_configs):
     # the second blocking behind the first (round-trips dominate on
     # high-latency links)
     idx_flat, loss_flat = jax.device_get(tuple(packed))
+    note_transfer("d2h", idx_flat.nbytes + loss_flat.nbytes, buffers=2)
     out, off = [], 0
     for k in num_configs:
         out.append((idx_flat[off:off + k], loss_flat[off:off + k]))
@@ -165,11 +168,12 @@ def make_fused_bracket_fn(
         )
 
     if mesh is None:
-        jitted_plain = jax.jit(bracket)
+        jitted_plain = tracked_jit(bracket, name="fused_bracket")
 
         def dispatch(vectors):
             """Launch the bracket; returns packed DEVICE arrays without
             blocking — callers may overlap several brackets before fetching."""
+            note_transfer("h2d", int(getattr(vectors, "nbytes", 0)))
             return jitted_plain(vectors)
 
     else:
@@ -178,7 +182,9 @@ def make_fused_bracket_fn(
         m = int(np.prod(list(mesh.shape.values())))
         n_pad = ((n0 + m - 1) // m) * m
         shard = NamedSharding(mesh, PartitionSpec(axis))
-        jitted = jax.jit(bracket, in_shardings=(shard,))
+        jitted = tracked_jit(
+            bracket, name="fused_bracket_sharded", in_shardings=(shard,)
+        )
 
         def dispatch(vectors):
             vectors = np.asarray(vectors, np.float32)
@@ -190,6 +196,7 @@ def make_fused_bracket_fn(
                 vectors = np.concatenate(
                     [vectors, np.zeros((n_pad - n0, vectors.shape[1]), np.float32)]
                 )
+            note_transfer("h2d", vectors.nbytes)
             return jitted(vectors)
 
     def runner(vectors):

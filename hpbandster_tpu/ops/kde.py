@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.special import logsumexp, ndtr, ndtri
 
+from hpbandster_tpu.obs.runtime import tracked_jit
+
 __all__ = [
     "KDE",
     "LOG_PDF_FLOOR",
@@ -183,7 +185,7 @@ def sample_around(
     return jnp.where(vartypes == 0, cont, disc)
 
 
-@partial(jax.jit, static_argnames=("num_samples",))
+@partial(tracked_jit, static_argnames=("num_samples",))
 def propose(
     key: jax.Array,
     good: KDE,
@@ -243,7 +245,7 @@ def generate_candidates(
     )(keys, good.data[idx])
 
 
-@partial(jax.jit, static_argnames=("n", "num_samples"))
+@partial(tracked_jit, static_argnames=("n", "num_samples"))
 def generate_candidates_seeded(
     seed: jax.Array,
     good: KDE,
@@ -264,7 +266,7 @@ def generate_candidates_seeded(
     )
 
 
-@partial(jax.jit, static_argnames=("n", "num_samples"))
+@partial(tracked_jit, static_argnames=("n", "num_samples"))
 def propose_batch_seeded_scored(
     seed: jax.Array,
     good: KDE,
@@ -316,7 +318,7 @@ def propose_batch_seeded(
     )[0]
 
 
-@partial(jax.jit, static_argnames=("num_samples",))
+@partial(tracked_jit, static_argnames=("num_samples",))
 def propose_batch(
     keys: jax.Array,
     good: KDE,

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hpbandster_tpu.obs.runtime import tracked_jit
 from hpbandster_tpu.ops.bracket import BracketPlan
 from hpbandster_tpu.ops.fused import fused_sh_bracket, _pack_stages
 from hpbandster_tpu.ops.kde import KDE, normal_reference_bandwidths, propose
@@ -986,5 +987,7 @@ def make_fused_sweep_fn(
         from jax.sharding import NamedSharding, PartitionSpec
 
         rep = NamedSharding(mesh, PartitionSpec())
-        return jax.jit(sweep, in_shardings=rep, out_shardings=rep)
-    return jax.jit(sweep)
+        return tracked_jit(
+            sweep, name="fused_sweep_spmd", in_shardings=rep, out_shardings=rep
+        )
+    return tracked_jit(sweep, name="fused_sweep")

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from hpbandster_tpu.obs.runtime import note_transfer, tracked_jit
 from hpbandster_tpu.utils.lru import LRUCache
 
 __all__ = ["VmapBackend"]
@@ -102,12 +103,13 @@ class VmapBackend:
             # (XLA inserts the all-gather; losses are tiny) — a sharded
             # output would not be addressable outside its home process
             out = rep if self._multiprocess else shard
-            return jax.jit(
+            return tracked_jit(
                 batch_fn,
+                name="vmap_batch_sharded",
                 in_shardings=(shard, rep),
                 out_shardings=out,
             )
-        return jax.jit(batch_fn)
+        return tracked_jit(batch_fn, name="vmap_batch")
 
     def evaluate(self, vectors: np.ndarray, budget: float) -> np.ndarray:
         """``f32[n, d]`` config vectors -> ``f32[n]`` losses (NaN = crashed)."""
@@ -130,6 +132,7 @@ class VmapBackend:
             self._compiled[key] = fn
         padded = np.zeros((n_pad, d), np.float32)
         padded[:n] = vectors
+        note_transfer("h2d", padded.nbytes)
         if self._multiprocess:
             # every process holds the identical full batch (deterministic
             # SPMD driver); assemble the global sharded array from the
@@ -141,4 +144,6 @@ class VmapBackend:
         else:
             batch = jnp.asarray(padded)
         losses = fn(batch, jnp.float32(budget))
-        return np.asarray(losses)[:n]
+        out = np.asarray(losses)
+        note_transfer("d2h", out.nbytes)
+        return out[:n]

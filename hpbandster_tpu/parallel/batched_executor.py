@@ -161,7 +161,14 @@ class BatchedExecutor:
             for j in jobs_sorted:
                 j.time_it("started")
             try:
-                packed = self._fused_fns[shape_key].dispatch(vectors)
+                # the dispatch span brackets the tracked-jit boundary
+                # (ops/fused.py): a first-wave tick here that dwarfs the
+                # steady state is compile time, and the xla_compile event
+                # the tracker journals says so explicitly
+                with obs.span(
+                    "fused_dispatch", iteration=iteration, n=len(jobs_sorted)
+                ):
+                    packed = self._fused_fns[shape_key].dispatch(vectors)
             except Exception as e:  # contain: only THIS bracket's wave crashes
                 self._crash_wave(jobs_sorted, e, "fused dispatch")
                 crashed = True
@@ -174,7 +181,10 @@ class BatchedExecutor:
 
         for iteration, info, jobs_sorted, packed in dispatched:
             try:
-                stages = _unpack_stages(packed, info["num_configs"])
+                # fetch span: the device->host transfer (counted in bytes
+                # by ops/fused._unpack_stages' runtime.transfer_* counters)
+                with obs.span("fused_fetch", iteration=iteration):
+                    stages = _unpack_stages(packed, info["num_configs"])
             except Exception as e:
                 self._crash_wave(jobs_sorted, e, "fused fetch")
                 continue

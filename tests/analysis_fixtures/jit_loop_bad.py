@@ -1,0 +1,84 @@
+"""Known-bad: jit wrappers constructed inside loop bodies (jit-in-loop).
+
+Each flagged line is marked ``# BAD``. Every construction here builds a
+fresh wrapper with an empty compile cache per iteration — guaranteed
+recompiles, the storm ``obs/runtime.py``'s tracker would report live.
+"""
+
+import functools
+
+import jax
+
+from hpbandster_tpu.obs.runtime import tracked_jit
+
+
+def per_iteration_jit(fns, xs):
+    out = []
+    for fn in fns:
+        out.append(jax.jit(fn)(xs))  # BAD
+    return out
+
+
+def while_loop_jit(fn, xs):
+    i = 0
+    while i < 3:
+        fn_c = jax.jit(fn)  # BAD
+        xs = fn_c(xs)
+        i += 1
+    return xs
+
+
+def jitted_lambda_per_config(scales, x):
+    results = []
+    for s in scales:
+        scaled = jax.jit(lambda v: v * s)  # BAD
+        results.append(scaled(x))
+    return results
+
+
+def deferred_lambda(fns, x):
+    out = []
+    for fn in fns:
+        # the construction hides inside a per-iteration lambda body
+        out.append(lambda v: jax.jit(fn)(v))  # BAD
+    return [f(x) for f in out]
+
+
+def comprehension_jit(fns):
+    return [jax.jit(fn) for fn in fns]  # BAD
+
+
+def tracked_in_loop(fns, x):
+    out = []
+    for fn in fns:
+        out.append(tracked_jit(fn)(x))  # BAD
+    return out
+
+
+def partial_in_loop(fns, x):
+    out = []
+    for fn in fns:
+        wrap = functools.partial(jax.jit, static_argnames="n")  # BAD
+        out.append(wrap(fn)(x, n=2))
+    return out
+
+
+def pmap_in_else(fns, x):
+    for fn in fns:
+        if fn is None:
+            break
+    else:
+        return jax.pmap(fns[0])(x)  # BAD
+    return x
+
+
+def jit_in_while_test(fn, x):
+    # the test expression runs every iteration: a construction per check
+    while jax.jit(fn)(x) > 0:  # BAD
+        x = x - 1
+    return x
+
+
+def jit_in_second_generator(batches, fn):
+    # the 2nd+ generator iterable re-evaluates per outer element
+    return [y for b in batches for y in jax.jit(fn)(b)]  # BAD

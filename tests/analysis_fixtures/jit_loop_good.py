@@ -1,0 +1,72 @@
+"""Known-good: the sanctioned jit-construction idioms (jit-in-loop).
+
+Hoisted wrappers called in loops, cached factories, vmap transforms in
+traced bodies, and one justified suppression — all silent.
+"""
+
+import jax
+
+from hpbandster_tpu.obs.runtime import tracked_jit
+
+_CACHE = {}
+
+
+@jax.jit
+def step(x):
+    return x * 2
+
+
+def hoisted_then_called(xs_list):
+    # the supported hot path: construct once, CALL per iteration
+    fn = jax.jit(step)
+    return [fn(xs) for xs in xs_list]
+
+
+def cached_factory(shape_key, fn):
+    # the ops/fused.py idiom: process-wide cache, one construction per key
+    cached = _CACHE.get(shape_key)
+    if cached is None:
+        cached = _CACHE[shape_key] = tracked_jit(fn, name="cached")
+    return cached
+
+
+def factory_defined_in_loop(fns):
+    # a def nested in the loop constructs only when called — judged there
+    makers = []
+    for fn in fns:
+        def make(f=fn):
+            return jax.jit(f)
+        makers.append(make)
+    return makers
+
+
+def first_generator_iterable(fn, xs):
+    # a comprehension's FIRST generator iterable is evaluated exactly
+    # once — this constructs one wrapper, not one per element
+    return [y + 1 for y in jax.jit(fn)(xs)]
+
+
+def for_statement_iterable(fn, xs):
+    # same once-evaluated position in statement form
+    total = 0
+    for y in jax.jit(fn)(xs):
+        total += y
+    return total
+
+
+def vmap_inside_trace(fn, rows):
+    # vmap is a transform, not a compile boundary: per-row staging inside
+    # a traced body is ordinary (the fused sweep's retry loop does this)
+    out = rows
+    for _ in range(3):
+        out = jax.vmap(fn)(out)
+    return out
+
+
+def deliberate_per_shape_compile(shapes, fn):
+    # measuring compile time per shape IS the point here
+    timings = []
+    for s in shapes:
+        jitted = jax.jit(fn)  # graftlint: disable=jit-in-loop — compile-benchmark harness: a fresh cache per shape is the measurement
+        timings.append(jitted(jax.numpy.zeros(s)))
+    return timings

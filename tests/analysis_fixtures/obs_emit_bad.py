@@ -9,12 +9,21 @@ import jax
 
 from hpbandster_tpu import obs
 from hpbandster_tpu.obs import emit, span
+from hpbandster_tpu.obs.runtime import tracked_jit
 
 
 @jax.jit
 def step(x):
     obs.emit("job_started", n=1)  # BAD
     return x * 2
+
+
+@tracked_jit
+def tracked_step(x):
+    # tracked_jit traces its body exactly like jax.jit: this emission
+    # fires once at trace time and never again
+    emit("job_started", n=1)  # BAD
+    return x * 3
 
 
 @jax.jit

@@ -3,12 +3,27 @@
 import jax
 
 from hpbandster_tpu import obs
+from hpbandster_tpu.obs.runtime import tracked_jit
 
 
 @jax.jit
 def step(x):
     # pure traced body: no host telemetry
     return x * 2
+
+
+@tracked_jit
+def tracked_step(x):
+    # a tracked_jit body is traced like any jit body: pure. The WRAPPER
+    # emits xla_compile from host code after the boundary — never from
+    # inside this traced region (the obs/runtime.py contract).
+    return x * 3
+
+
+def run_tracked(xs):
+    with obs.span("wave_evaluate", n=len(xs)):
+        out = tracked_step(xs)
+    return out
 
 
 def run_wave(xs):

@@ -27,6 +27,7 @@ RULE_TO_BAD_FIXTURE = {
     "pytest-marker": "test_markers_bad.py",
     "obs-emit-in-jit": "obs_emit_bad.py",
     "obs-reserved-fields": "obs_reserved_bad.py",
+    "jit-in-loop": "jit_loop_bad.py",
 }
 
 

@@ -292,6 +292,10 @@ def _stub_tiers(monkeypatch, calls):
         lambda **kw: calls.setdefault("obs_overhead", True)
         and {"overhead_pct": 0.1})
     monkeypatch.setattr(
+        bench, "bench_runtime_overhead",
+        lambda **kw: calls.setdefault("runtime_overhead", True)
+        and {"overhead_pct": 0.01, "tracked_overhead_ns": 900.0})
+    monkeypatch.setattr(
         bench, "bench_report_100k",
         lambda **kw: calls.setdefault("report_100k", True)
         and {"n_events": 100000, "events_per_s": 1, "deterministic": True})
@@ -446,7 +450,8 @@ class TestTierSelection:
         assert set(bench.TIER_ORDER) == {
             "cnn", "cnn_wide", "pallas", "resnet", "transformer",
             "fused10k", "chunked10k", "chunked_compile", "fused", "rpc",
-            "batched", "teacher", "obs_overhead", "report_100k",
+            "batched", "teacher", "obs_overhead", "runtime_overhead",
+            "report_100k",
         }
 
 

@@ -1,0 +1,279 @@
+"""Prometheus exporter (obs/export.py): strict exposition round trips.
+
+Satellite + acceptance contract (ISSUE 5): the exposition parses under a
+strict text-format parser (name/label escaping, NaN-free values, stable
+ordering), is byte-identical across two scrapes of a frozen registry,
+and a curl-equivalent fetch of the HTTP endpoint carries the same
+counter values as ``MetricsRegistry.snapshot()``.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from hpbandster_tpu import obs
+from hpbandster_tpu.obs.export import (
+    CONTENT_TYPE,
+    ExporterServer,
+    metric_family,
+    parse_prometheus_text,
+    render_registry,
+    render_snapshot,
+    snapshot_fetcher,
+)
+
+
+def _frozen_registry():
+    reg = obs.MetricsRegistry()
+    reg.counter("master.jobs").inc(7)
+    reg.counter("runtime.compiles").inc(3)
+    reg.counter("runtime.compiles.fused_sweep").inc(2)
+    reg.counter("runtime.compiles.vmap_batch").inc(1)
+    reg.counter("anomaly.alerts.recompile_storm").inc(4)
+    reg.gauge("dispatcher.queue_depth").set(5.5)
+    reg.gauge("runtime.device.0.bytes_in_use").set(1024)
+    reg.gauge("runtime.device.1.bytes_in_use").set(2048)
+    # a worker name needing every escape class
+    reg.gauge('dispatcher.worker_last_seen_age_s.w"1\\a\nb').set(2.0)
+    h = reg.histogram("master.job_run_s", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    return reg
+
+
+class TestRender:
+    def test_two_scrapes_of_frozen_registry_are_byte_identical(self):
+        reg = _frozen_registry()
+        a = render_registry(reg)
+        b = render_registry(reg)
+        assert a == b
+        assert isinstance(a, str) and a.endswith("\n")
+
+    def test_round_trips_through_strict_parser(self):
+        reg = _frozen_registry()
+        text = render_registry(reg)
+        families = parse_prometheus_text(text)
+        snap = reg.snapshot()
+        # every counter value survives the round trip
+        flat = {}
+        for fam, slot in families.items():
+            for labels, value in slot["samples"]:
+                flat[(fam, tuple(sorted(labels.items())))] = value
+        assert flat[("hpbandster_master_jobs_total", ())] == 7
+        assert flat[("hpbandster_runtime_compiles_total", ())] == 3
+        assert flat[(
+            "hpbandster_runtime_fn_compiles_total", (("fn", "fused_sweep"),)
+        )] == 2
+        assert flat[(
+            "hpbandster_anomaly_rule_alerts_total",
+            (("rule", "recompile_storm"),),
+        )] == 4
+        assert flat[("hpbandster_dispatcher_queue_depth", ())] == 5.5
+        assert flat[(
+            "hpbandster_runtime_device_bytes_in_use", (("device", "0"),)
+        )] == 1024
+        # the label value with quote/backslash/newline round-trips intact
+        assert flat[(
+            "hpbandster_dispatcher_worker_last_seen_age_s",
+            (("worker", 'w"1\\a\nb'),),
+        )] == 2.0
+        # histogram quantiles surface as _p50/_p95 gauges
+        hist = snap["histograms"]["master.job_run_s"]
+        assert flat[("hpbandster_master_job_run_s_count", ())] == hist["count"]
+        assert flat[("hpbandster_master_job_run_s_p50", ())] == hist["p50"]
+        assert flat[("hpbandster_master_job_run_s_p95", ())] == hist["p95"]
+
+    def test_families_and_samples_are_sorted(self):
+        text = render_registry(_frozen_registry())
+        fams = [
+            line.split()[2]
+            for line in text.splitlines() if line.startswith("# TYPE")
+        ]
+        assert fams == sorted(fams)
+        device_lines = [
+            l for l in text.splitlines()
+            if l.startswith("hpbandster_runtime_device_bytes_in_use{")
+        ]
+        assert device_lines == sorted(device_lines)
+
+    def test_nonfinite_values_never_render(self):
+        reg = obs.MetricsRegistry()
+        reg.gauge("bad.nan").set(float("nan"))
+        reg.gauge("bad.inf").set(float("inf"))
+        reg.gauge("good").set(1.0)
+        text = render_registry(reg)
+        assert "bad_nan" not in text and "bad_inf" not in text
+        assert "hpbandster_good 1.0\n" in text
+        parse_prometheus_text(text)  # and it still parses strictly
+
+    def test_empty_registry_renders_empty(self):
+        assert render_registry(obs.MetricsRegistry()) == ""
+        assert parse_prometheus_text("") == {}
+
+    def test_metric_family_sanitization(self):
+        fam, labels = metric_family("weird name-with.chars")
+        assert fam == "hpbandster_weird_name_with_chars"
+        assert labels == {}
+        fam, labels = metric_family("runtime.device.3.bytes_limit")
+        assert fam == "hpbandster_runtime_device_bytes_limit"
+        assert labels == {"device": "3"}
+
+
+class TestStrictParser:
+    def test_rejects_missing_trailing_newline(self):
+        with pytest.raises(ValueError, match="newline"):
+            parse_prometheus_text("# HELP a b\n# TYPE a gauge\na 1")
+
+    def test_rejects_sample_before_type(self):
+        with pytest.raises(ValueError):
+            parse_prometheus_text("a 1\n")
+        with pytest.raises(ValueError, match="TYPE"):
+            parse_prometheus_text("# HELP a b\na 1\n")
+
+    def test_rejects_duplicate_sample(self):
+        text = "# HELP a b\n# TYPE a gauge\na 1\na 2\n"
+        with pytest.raises(ValueError, match="duplicate"):
+            parse_prometheus_text(text)
+
+    def test_rejects_nonfinite_value(self):
+        text = "# HELP a b\n# TYPE a gauge\na NaN\n"
+        with pytest.raises(ValueError, match="non-finite"):
+            parse_prometheus_text(text)
+
+    def test_rejects_bad_escape(self):
+        text = '# HELP a b\n# TYPE a gauge\na{x="\\q"} 1\n'
+        with pytest.raises(ValueError, match="escape"):
+            parse_prometheus_text(text)
+
+    def test_rejects_interleaved_families(self):
+        text = (
+            "# HELP a b\n# TYPE a gauge\na 1\n"
+            "# HELP c d\n# TYPE c gauge\nc 1\na 2\n"
+        )
+        with pytest.raises(ValueError):
+            parse_prometheus_text(text)
+
+
+class TestHttpEndpoint:
+    def test_curl_equivalent_fetch_matches_registry_snapshot(self):
+        """Acceptance: GET /metrics yields strict exposition whose
+        counter values equal MetricsRegistry.snapshot()'s."""
+        reg = _frozen_registry()
+        server = ExporterServer(0, fetch=lambda: render_registry(reg)).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/metrics"
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"] == CONTENT_TYPE
+                body = resp.read().decode("utf-8")
+        finally:
+            server.close()
+        families = parse_prometheus_text(body)
+        snap = reg.snapshot()
+        got = {
+            fam: value
+            for fam, slot in families.items()
+            for labels, value in slot["samples"] if not labels
+        }
+        for name, value in snap["counters"].items():
+            fam, labels = metric_family(name)
+            if not labels:
+                assert got[fam + "_total"] == value, name
+
+    def test_unknown_path_is_404_and_failure_is_503(self):
+        boom = {"on": False}
+
+        def fetch():
+            if boom["on"]:
+                raise RuntimeError("peer vanished")
+            return render_registry(obs.MetricsRegistry())
+
+        server = ExporterServer(0, fetch=fetch).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(base + "/nope", timeout=5)
+            assert e.value.code == 404
+            boom["on"] = True
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(base + "/metrics", timeout=5)
+            assert e.value.code == 503
+            assert "peer vanished" in e.value.read().decode()
+        finally:
+            server.close()
+
+
+class TestFleetBridge:
+    def test_health_endpoint_registers_metrics_text(self):
+        from hpbandster_tpu.parallel.rpc import RPCProxy, RPCServer
+
+        reg = _frozen_registry()
+        srv = RPCServer("127.0.0.1", 0)
+        obs.HealthEndpoint(component="worker", registry=reg).register(srv)
+        srv.start()
+        try:
+            text = RPCProxy(srv.uri).call("metrics_text")
+            families = parse_prometheus_text(text)
+            assert ("hpbandster_master_jobs_total") in families
+            # bridge mode: the exporter's fetch closure re-renders the
+            # peer's obs_snapshot metrics — same counters either way
+            bridged = snapshot_fetcher(srv.uri)()
+            assert parse_prometheus_text(bridged)[
+                "hpbandster_master_jobs_total"
+            ]["samples"] == families["hpbandster_master_jobs_total"]["samples"]
+        finally:
+            srv.shutdown()
+
+
+class TestCli:
+    def test_export_once_prints_exposition(self, capsys):
+        from hpbandster_tpu.obs.__main__ import main
+
+        obs.get_metrics().counter("cli.test_hits").inc()
+        assert main(["export", "--once"]) == 0
+        out = capsys.readouterr().out
+        parse_prometheus_text(out)
+        assert "hpbandster_cli_test_hits_total" in out
+
+    def test_export_bad_snapshot_uri_is_usage_error(self, capsys):
+        from hpbandster_tpu.obs.__main__ import main
+
+        assert main(["export", "--once", "--snapshot", "not a uri"]) == 2
+        assert "invalid --snapshot" in capsys.readouterr().err
+
+    def test_export_port_in_use_is_clean_error_not_traceback(self, capsys):
+        from hpbandster_tpu.obs.__main__ import main
+
+        holder = ExporterServer(0)  # never started; just holds the port
+        try:
+            assert main(["export", "--port", str(holder.port)]) == 2
+            assert "cannot bind exporter" in capsys.readouterr().err
+        finally:
+            holder.close()
+
+    def test_snapshot_runtime_metrics_flow_end_to_end(self):
+        """tracked_jit -> registry -> health RPC -> bridge -> parser:
+        the whole fleet-scrape pipe in one process."""
+        import numpy as np
+
+        from hpbandster_tpu.obs.runtime import CompileTracker, tracked_jit
+        from hpbandster_tpu.parallel.rpc import RPCServer
+
+        reg = obs.MetricsRegistry()
+        f = tracked_jit(
+            lambda x: x + 1, name="pipe_fn",
+            tracker=CompileTracker(), registry=reg,
+        )
+        f(np.ones(2, np.float32))
+        srv = RPCServer("127.0.0.1", 0)
+        obs.HealthEndpoint(component="worker", registry=reg).register(srv)
+        srv.start()
+        try:
+            text = snapshot_fetcher(srv.uri)()
+        finally:
+            srv.shutdown()
+        families = parse_prometheus_text(text)
+        samples = families["hpbandster_runtime_fn_compiles_total"]["samples"]
+        assert samples == [({"fn": "pipe_fn"}, 1.0)]

@@ -1,0 +1,383 @@
+"""XLA runtime telemetry (obs/runtime.py): tracked_jit, the device
+sampler, transfer counters, the recompile_storm anomaly rule, and the
+runtime sections of snapshot/summarize/report/watch.
+
+Acceptance criteria pinned here (ISSUE 5):
+
+* churning input shapes through a ``tracked_jit`` function raises the
+  recompile counter and fires the ``recompile_storm`` anomaly;
+* a shape-stable run of the fused sweep compiles each function exactly
+  once.
+"""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from hpbandster_tpu import obs
+from hpbandster_tpu.obs.anomaly import AnomalyDetector, AnomalyRules
+from hpbandster_tpu.obs.runtime import (
+    CompileTracker,
+    DeviceSampler,
+    get_compile_tracker,
+    note_transfer,
+    runtime_snapshot,
+    tracked_jit,
+)
+
+
+@pytest.fixture
+def fresh():
+    """Private bus + registry + tracker: no cross-test leakage."""
+    return obs.EventBus(), obs.MetricsRegistry(), CompileTracker()
+
+
+class TestTrackedJit:
+    def test_one_compile_per_signature(self, fresh):
+        bus, reg, trk = fresh
+        calls = []
+        detach = bus.subscribe(lambda ev: calls.append(ev))
+        f = tracked_jit(
+            lambda x: x * 2, name="double", tracker=trk, registry=reg, bus=bus
+        )
+        np.testing.assert_allclose(f(np.ones(3)), 2 * np.ones(3))
+        np.testing.assert_allclose(f(np.ones(3)), 2 * np.ones(3))
+        detach()
+        led = trk.snapshot()
+        assert led["functions"]["double"]["compiles"] == 1
+        assert led["functions"]["double"]["recompiles"] == 0
+        assert [e.name for e in calls] == [obs.XLA_COMPILE]
+        ev = calls[0].fields
+        assert ev["fn"] == "double"
+        assert ev["compile_s"] > 0
+        assert "float64[3]" in ev["signature"]
+        assert reg.snapshot()["counters"]["runtime.compiles"] == 1
+
+    def test_shape_churn_raises_recompile_counter(self, fresh):
+        bus, reg, trk = fresh
+        f = tracked_jit(
+            lambda x: x + 1, name="churn", tracker=trk, registry=reg, bus=bus
+        )
+        for n in range(1, 5):
+            f(np.ones(n, np.float32))
+        led = trk.snapshot()["functions"]["churn"]
+        assert led["compiles"] == 4
+        assert led["recompiles"] == 3
+        counters = reg.snapshot()["counters"]
+        assert counters["runtime.compiles.churn"] == 4
+        assert counters["runtime.tracked_calls"] == 4
+
+    def test_static_argnames_pass_through(self, fresh):
+        bus, reg, trk = fresh
+        from functools import partial
+
+        @partial(tracked_jit, static_argnames="n",
+                 tracker=trk, registry=reg, bus=bus)
+        def repeat(x, n):
+            import jax.numpy as jnp
+
+            return jnp.tile(x, n)
+
+        assert repeat(np.ones(2, np.float32), n=2).shape == (4,)
+        assert repeat(np.ones(2, np.float32), n=3).shape == (6,)
+        # a distinct static value is a distinct signature -> a compile
+        assert trk.snapshot()["functions"]["repeat"]["compiles"] == 2
+
+    def test_nested_trace_passthrough_never_emits(self, fresh):
+        """The wrapper must not record (or emit) while being traced into
+        an enclosing computation — the obs-emit-in-jit contract."""
+        import jax
+
+        bus, reg, trk = fresh
+        inner = tracked_jit(
+            lambda x: x * 3, name="inner", tracker=trk, registry=reg, bus=bus
+        )
+
+        @jax.jit
+        def outer(x):
+            return inner(x) + 1
+
+        np.testing.assert_allclose(
+            outer(np.ones(2, np.float32)), 4 * np.ones(2)
+        )
+        assert "inner" not in trk.snapshot()["functions"]
+
+    def test_disabled_obs_skips_tracking(self, fresh):
+        bus, reg, trk = fresh
+        f = tracked_jit(
+            lambda x: x - 1, name="off", tracker=trk, registry=reg, bus=bus
+        )
+        obs.set_enabled(False)
+        try:
+            f(np.ones(2))
+        finally:
+            obs.set_enabled(True)
+        assert trk.snapshot()["total_compiles"] == 0
+
+    def test_aot_lower_compile_is_tracked(self, fresh):
+        bus, reg, trk = fresh
+        f = tracked_jit(
+            lambda x: x * 5, name="aot", tracker=trk, registry=reg, bus=bus
+        )
+        compiled = f.lower(np.ones(3, np.float32)).compile()
+        np.testing.assert_allclose(
+            compiled(np.ones(3, np.float32)), 5 * np.ones(3)
+        )
+        assert trk.snapshot()["functions"]["aot"]["compiles"] == 1
+
+
+class TestRecompileStormAnomaly:
+    def test_shape_churn_fires_recompile_storm(self, fresh):
+        """Acceptance: churn shapes -> counter rises AND the anomaly
+        detector fires recompile_storm for that function."""
+        bus, reg, trk = fresh
+        det = AnomalyDetector(
+            rules=AnomalyRules(recompile_threshold=3), bus=bus, registry=reg
+        )
+        detach = bus.subscribe(det)
+        f = tracked_jit(
+            lambda x: x + 2, name="stormy", tracker=trk, registry=reg, bus=bus
+        )
+        for n in range(1, 6):
+            f(np.ones(n, np.float32))
+        detach()
+        assert trk.snapshot()["functions"]["stormy"]["recompiles"] == 4
+        assert det.alert_counts.get("recompile_storm", 0) >= 1
+        storm = [a for a in det.alerts if a["rule"] == "recompile_storm"][0]
+        assert storm["subject"] == "stormy"
+        assert storm["compiles"] >= 3
+        assert reg.snapshot()["counters"]["anomaly.alerts.recompile_storm"] >= 1
+
+    def test_offline_scan_replays_the_rule(self):
+        recs = [
+            {"event": "xla_compile", "t_wall": 100.0 + i, "fn": "f",
+             "compile_s": 0.5, "compiles": i + 1, "recompiles": i}
+            for i in range(4)
+        ]
+        alerts = obs.scan_records(recs, AnomalyRules(recompile_threshold=3))
+        assert [a["rule"] for a in alerts] == ["recompile_storm"]
+        assert alerts[0]["t_wall"] == 102.0  # stamped from the record
+
+    def test_single_compile_is_silent(self):
+        recs = [{"event": "xla_compile", "t_wall": 1.0, "fn": "f",
+                 "compile_s": 9.0}]
+        assert obs.scan_records(recs) == []
+
+    def test_healthy_sweep_compile_set_is_silent_under_defaults(self):
+        """One compile per bracket shape (max_SH_iter = 4 at budgets
+        1..81) plus a KDE proposal compile is a HEALTHY sweep — the
+        default threshold must not flag it (verified live: a 3-bracket
+        batched sweep tripped the old default of 3)."""
+        recs = [
+            {"event": "xla_compile", "t_wall": float(i), "fn": "fused_bracket",
+             "compile_s": 0.4} for i in range(4)
+        ] + [{"event": "xla_compile", "t_wall": 9.0,
+              "fn": "propose_batch_seeded_scored", "compile_s": 3.0}]
+        assert obs.scan_records(recs) == []
+
+
+class TestFusedSweepCompileAccounting:
+    def test_shape_stable_sweep_compiles_each_function_once(self):
+        """Acceptance: a shape-stable fused sweep run shows exactly one
+        compile per function in the ledger."""
+        from hpbandster_tpu.ops.bracket import BracketPlan
+        from hpbandster_tpu.ops.sweep import build_space_codec, make_fused_sweep_fn
+        from hpbandster_tpu.workloads.toys import branin_from_vector, branin_space
+
+        tracker = get_compile_tracker()
+        tracker.reset()
+        codec = build_space_codec(branin_space(seed=3))
+        plans = [BracketPlan((3, 1), (1.0, 3.0)), BracketPlan((2,), (3.0,))]
+        fn = make_fused_sweep_fn(branin_from_vector, plans, codec)
+        fn(0)
+        fn(1)  # same shapes: the cached executable serves it
+        led = tracker.snapshot()
+        assert led["functions"]["fused_sweep"]["compiles"] == 1
+        assert led["functions"]["fused_sweep"]["recompiles"] == 0
+
+    def test_fused_bracket_runner_compiles_once(self):
+        from hpbandster_tpu.ops.fused import make_fused_bracket_fn
+
+        def eval_fn(v, budget):
+            return (v * v).sum() / budget
+
+        tracker = get_compile_tracker()
+        tracker.reset()
+        runner = make_fused_bracket_fn(eval_fn, (4, 1), (1.0, 3.0))
+        vecs = np.random.default_rng(0).random((4, 2)).astype(np.float32)
+        runner(vecs)
+        runner(vecs)
+        assert tracker.snapshot()["functions"]["fused_bracket"]["compiles"] == 1
+
+
+class TestDeviceSampler:
+    def test_sample_publishes_gauges_and_census(self):
+        reg = obs.MetricsRegistry()
+        sampler = DeviceSampler(registry=reg)
+        census = sampler.sample()
+        assert census["device_count"] >= 1
+        assert sampler.last_sample() is not None
+        gauges = reg.snapshot()["gauges"]
+        assert gauges["runtime.device_count"] == census["device_count"]
+        assert "runtime.device.0.live_buffers" in gauges
+
+    def test_start_stop_thread(self):
+        reg = obs.MetricsRegistry()
+        sampler = DeviceSampler(interval_s=0.05, registry=reg)
+        sampler.start()
+        try:
+            import time
+
+            deadline = time.monotonic() + 5.0
+            while sampler.last_sample() is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            sampler.stop()
+        assert sampler.last_sample() is not None
+        sampler.stop()  # idempotent
+
+
+class TestTransferCounters:
+    def test_note_transfer_counts_buffers_and_bytes(self):
+        reg = obs.MetricsRegistry()
+        note_transfer("d2h", 1024, buffers=2, registry=reg)
+        note_transfer("d2h", 512, registry=reg)
+        c = reg.snapshot()["counters"]
+        assert c["runtime.transfers_d2h"] == 3
+        assert c["runtime.transfer_bytes_d2h"] == 1536
+        with pytest.raises(ValueError):
+            note_transfer("sideways", 1)
+
+    def test_fused_unpack_counts_d2h(self):
+        from hpbandster_tpu.ops.fused import make_fused_bracket_fn
+
+        before = (
+            obs.get_metrics().counter("runtime.transfers_d2h").value
+        )
+        runner = make_fused_bracket_fn(
+            lambda v, b: (v * v).sum() / b, (3, 1), (1.0, 3.0)
+        )
+        runner(np.ones((3, 2), np.float32))
+        after = obs.get_metrics().counter("runtime.transfers_d2h").value
+        assert after > before
+
+
+class TestRuntimeSections:
+    def test_health_snapshot_carries_runtime_section(self):
+        get_compile_tracker().reset()
+        f = tracked_jit(lambda x: x + 1, name="snap_fn")
+        f(np.ones(2, np.float32))
+        snap = obs.HealthEndpoint(component="test").snapshot()
+        rt = snap["runtime"]
+        assert rt["compile"]["functions"]["snap_fn"]["compiles"] == 1
+        json.dumps(snap)  # the whole snapshot stays JSON-serializable
+
+    def test_runtime_snapshot_without_sampler(self):
+        rt = runtime_snapshot()
+        assert rt["devices"] is None or isinstance(rt["devices"], dict)
+        assert "compile" in rt
+
+    def test_summarize_reports_compile_share_and_top_recompilers(self):
+        recs = [
+            {"event": "xla_compile", "t_wall": 0.0, "fn": "a", "compile_s": 4.0},
+            {"event": "xla_compile", "t_wall": 1.0, "fn": "b", "compile_s": 1.0},
+            {"event": "xla_compile", "t_wall": 2.0, "fn": "b", "compile_s": 1.0},
+            {"event": "job_finished", "t_wall": 10.0, "run_s": 1.0,
+             "trace_id": "t1"},
+        ]
+        from hpbandster_tpu.obs.summarize import format_summary, summarize_records
+
+        s = summarize_records(recs)
+        rt = s["runtime"]
+        assert rt["compiles"] == 3
+        assert rt["compile_s"] == 6.0
+        assert rt["compile_share_of_wall"] == 0.6
+        assert rt["top_recompilers"][0]["fn"] == "b"
+        text = format_summary(s)
+        assert "xla runtime: 3 compiles" in text
+        assert "60.0% of wall" in text
+
+    def test_report_runtime_section_is_deterministic(self):
+        from hpbandster_tpu.obs.report import build_report, format_report
+
+        recs = [
+            {"event": "xla_compile", "t_wall": 0.0, "fn": "sweep",
+             "compile_s": 2.0},
+            {"event": "xla_compile", "t_wall": 5.0, "fn": "sweep",
+             "compile_s": 2.0},
+            {"event": "job_finished", "t_wall": 10.0, "loss": 1.0,
+             "config_id": [0, 0, 0], "budget": 1.0},
+        ]
+        rep = build_report(recs)
+        rt = rep["runtime"]
+        assert rt["compiles"] == 2 and rt["compile_s"] == 4.0
+        assert rt["top_recompilers"][0]["recompiles"] == 1
+        a = format_report(build_report(recs))
+        b = format_report(build_report(recs))
+        assert a == b
+        assert "xla runtime:" in a and "sweep" in a
+
+    def test_watch_line_counts_compiles(self):
+        from hpbandster_tpu.obs.summarize import _WatchState
+
+        st = _WatchState()
+        st.update({"event": "xla_compile", "t_wall": 1.0, "fn": "f"})
+        st.update({"event": "xla_compile", "t_wall": 2.0, "fn": "f"})
+        assert "compiles=2" in st.line()
+
+    def test_watch_snapshot_renders_runtime_part(self):
+        from hpbandster_tpu.obs.summarize import _snapshot_runtime_part
+
+        snap = {
+            "runtime": {
+                "compile": {"total_compiles": 3, "total_compile_s": 1.5},
+                "devices": {
+                    "devices": {
+                        "0": {"bytes_in_use": 2 * 1024 * 1024,
+                              "bytes_limit": 16 * 1024 * 1024,
+                              "live_buffers": 7},
+                        "1": {"live_buffers": 4},
+                    }
+                },
+            }
+        }
+        part = _snapshot_runtime_part(snap)
+        assert "compiles=3(1.5s)" in part
+        assert "dev0=2.0MiB/16.0MiB" in part
+        assert "dev1=4buf" in part
+        # no runtime section -> no clutter (and no crash)
+        assert _snapshot_runtime_part({}) == ""
+
+    def test_watch_snapshot_e2e_over_rpc(self):
+        from hpbandster_tpu.obs.summarize import watch_snapshot
+        from hpbandster_tpu.parallel.rpc import RPCServer
+
+        srv = RPCServer("127.0.0.1", 0)
+        obs.HealthEndpoint(component="worker").register(srv)
+        srv.start()
+        try:
+            out = io.StringIO()
+            assert watch_snapshot(srv.uri, interval=0.01, ticks=1,
+                                  stream=out) == 0
+            line = out.getvalue()
+            assert "worker" in line
+        finally:
+            srv.shutdown()
+
+    def test_configure_device_sampler_lifecycle(self):
+        handle = obs.configure(device_sampler=0.05)
+        try:
+            assert handle.sampler is not None
+            import time
+
+            deadline = time.monotonic() + 5.0
+            while (handle.sampler.last_sample() is None
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            rt = runtime_snapshot()
+            assert rt["devices"] is not None
+        finally:
+            handle.close()
+        assert runtime_snapshot()["devices"] is None
