@@ -6,6 +6,7 @@ decorated with ``@register`` and importing it below (see
 """
 
 from hpbandster_tpu.analysis.rules import (  # noqa: F401
+    donation,
     exceptions,
     jit_loop,
     jit_purity,

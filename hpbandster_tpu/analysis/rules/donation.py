@@ -1,0 +1,95 @@
+"""jit-donation — sharded jit call sites must take an explicit donation
+stance.
+
+``jax.jit`` / ``tracked_jit`` call sites that pass ``in_shardings`` /
+``out_shardings`` are, by construction, the repo's LARGE-buffer program
+boundaries: sharding only exists because the arrays are big enough to
+spread over a mesh. Exactly there, buffer donation is the difference
+between XLA updating state in place (the fused sweep's warm-buffer
+thread, ops/sweep.py) and a dead copy round-tripping the host link — the
+compile/transfer tax the runtime telemetry (PR 5) measures and the budget
+gate (bench.py ``TIER_BUDGETS``) enforces.
+
+Donation is not always RIGHT, though: a buffer whose outputs cannot alias
+it (shape/dtype mismatch) gains nothing, and donating a caller-reused
+array is a correctness bug. So the rule does not demand donation — it
+demands a DECISION: every sharded jit call site must carry an explicit
+``donate_argnums=`` / ``donate_argnames=`` keyword. ``donate_argnums=()``
+is a valid stance ("considered, declined" — pair it with a rationale
+comment, see docs/perf_notes.md "Buffer donation contract"). A ``**kwargs``
+splat passes too (the decision lives wherever the dict is built — static
+analysis cannot see into it).
+
+Not flagged:
+
+* unsharded jit sites — small/host-shaped programs where the donation
+  question is usually moot (and the noise would drown the signal);
+* ``jax.vmap``/transform calls — no compile boundary, nothing to donate.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from hpbandster_tpu.analysis.core import Finding, Rule, SourceModule, register
+from hpbandster_tpu.analysis.rules._util import ImportMap, import_map_for
+
+#: wrappers that compile device programs and accept donate_argnums
+_JIT_WRAPPERS = {
+    "jax.jit",
+    "jit",
+    "jax.pmap",
+    "pmap",
+    "tracked_jit",
+    "hpbandster_tpu.obs.tracked_jit",
+    "hpbandster_tpu.obs.runtime.tracked_jit",
+}
+
+_SHARDING_KWARGS = {"in_shardings", "out_shardings"}
+_DONATION_KWARGS = {"donate_argnums", "donate_argnames"}
+
+
+@register
+class JitDonationRule(Rule):
+    name = "jit-donation"
+    description = (
+        "sharded jit call site (in_shardings/out_shardings) without an "
+        "explicit donate_argnums/donate_argnames — large-buffer program "
+        "boundaries must take a donation stance (donate_argnums=() = "
+        "considered and declined)"
+    )
+
+    def check(self, module: SourceModule) -> List[Finding]:
+        # sound prefilter: a flaggable call must spell a sharding kwarg
+        if not any(t in module.text for t in _SHARDING_KWARGS):
+            return []
+        imports = import_map_for(module)
+        findings: List[Finding] = []
+        for node in module.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = imports.resolve(node.func) or ""
+            if resolved not in _JIT_WRAPPERS:
+                continue
+            kw_names = {kw.arg for kw in node.keywords if kw.arg is not None}
+            if not (kw_names & _SHARDING_KWARGS):
+                continue
+            if kw_names & _DONATION_KWARGS:
+                continue
+            if any(kw.arg is None for kw in node.keywords):
+                # **splat: the decision may live in the dict — unanalyzable,
+                # treated as an explicit stance
+                continue
+            findings.append(
+                self.finding(
+                    module, node,
+                    f"{resolved}(...) passes "
+                    f"{sorted(kw_names & _SHARDING_KWARGS)} but no "
+                    "donate_argnums/donate_argnames — sharded call sites "
+                    "move large buffers; state the donation decision "
+                    "explicitly (donate_argnums=() with a rationale "
+                    "comment to decline)",
+                )
+            )
+        return findings

@@ -253,6 +253,30 @@ class Master:
         # the TOTAL bracket count, matching the semantics of re-running the
         # original call after a crash
         n_remaining = max(n_iterations - len(self.iterations), 0)
+
+        # schedule announcement seam (ops/buckets.py): optimizers that can
+        # compute their bracket shapes ahead of time (iteration_plan) hand
+        # the remaining schedule to executors that can precompile for it
+        # (prepare_schedule) — the batched executor buckets the shapes and
+        # AOT-compiles the bucket programs in the background, overlapped
+        # with the stage-0 sampling this loop is about to start. Purely an
+        # optimization: any failure here degrades to per-shape compiles.
+        plan_of = getattr(self, "iteration_plan", None)
+        prepare = getattr(self.executor, "prepare_schedule", None)
+        if callable(plan_of) and callable(prepare) and n_remaining > 0:
+            try:
+                prepare([
+                    plan_of(i)
+                    for i in range(
+                        len(self.iterations),
+                        len(self.iterations) + n_remaining,
+                    )
+                ])
+            except Exception:
+                self.logger.exception(
+                    "executor schedule preparation failed; continuing "
+                    "with per-shape compilation"
+                )
         while True:
             with self.thread_cond:
                 # respect the in-flight window (async executors)

@@ -118,6 +118,15 @@ class Worker:
         until shutdown."""
         if self.nameserver is None:
             raise RuntimeError("no nameserver specified (or credentials loaded)")
+        # compute() may build jitted device programs: point jax's
+        # persistent compile cache at the shared directory BEFORE any
+        # compile, so a restarted worker pays no recompile tax
+        # (HPB_XLA_CACHE=0 opts out — docs/perf_notes.md)
+        from hpbandster_tpu.utils.compile_cache import (
+            enable_persistent_compile_cache,
+        )
+
+        enable_persistent_compile_cache()
         if self.journal_path is not None and self._journal is None:
             # the worker's own half of the distributed story: every record
             # stamped with this process's identity (merge-ready)

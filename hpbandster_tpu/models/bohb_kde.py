@@ -63,10 +63,25 @@ class BOHBKDE(base_config_generator):
         seed: Optional[int] = None,
         proposal_batch_size: int = 128,
         use_pallas: Optional[bool] = None,
+        in_trace_refit: Optional[bool] = None,
         **kwargs,
     ):
         super().__init__(**kwargs)
         self.configspace = configspace
+        # in-trace refit (ops.kde.refit_propose_batch_seeded): the KDE
+        # fit AND the proposal run as ONE device dispatch over raw
+        # observation buffers — no host-side fit, no fitted-model upload
+        # per refit. Opt-in (None -> env HPB_IN_TRACE_REFIT=1): the
+        # device fit is the same model from the same observations, but
+        # bandwidths compute in f32 on-device (vs numpy float64) and the
+        # conditional-space imputation draws from a jax key instead of
+        # the numpy rng — a distinct RNG consumer, deterministic in its
+        # own seed, like the dynamic fused tier (docs/perf_notes.md).
+        if in_trace_refit is None:
+            import os
+
+            in_trace_refit = os.environ.get("HPB_IN_TRACE_REFIT", "") == "1"
+        self.in_trace_refit = bool(in_trace_refit)
         # opt-in Pallas scorer for the proposal hot loop (ops/pallas_kde.py);
         # None -> env HPB_USE_PALLAS=1 + a TPU backend enables it
         if use_pallas is None:
@@ -140,7 +155,33 @@ class BOHBKDE(base_config_generator):
             self._fit_kde_pair(budget)
         self._dirty_budgets.clear()
 
+    def _trained_split(self, n: int) -> Optional[Tuple[int, int]]:
+        """The reference's split arithmetic as a pure gate — the integer
+        twin of ``_fit_kde_pair``'s decisions (which must keep ITS gate
+        after imputation for RNG-stream compatibility): ``(n_good,
+        n_bad)`` when a model can exist at ``n`` observations, else
+        None. The in-trace refit path and the fused sweep's
+        ``trained_split`` agree with this by construction."""
+        if n < self.min_points_in_model + 2:
+            return None
+        n_good = max(self.min_points_in_model, (self.top_n_percent * n) // 100)
+        n_bad = max(
+            self.min_points_in_model, ((100 - self.top_n_percent) * n) // 100
+        )
+        d = len(self.vartypes)
+        if n_good <= d or n_bad <= d:
+            return None
+        return n_good, n_bad
+
     def largest_budget_with_model(self) -> Optional[float]:
+        if self.in_trace_refit:
+            # gate by counts alone — the fit itself happens in-trace at
+            # proposal time, so no host model ever needs to exist
+            trained = [
+                b for b, ls in self.losses.items()
+                if self._trained_split(len(ls)) is not None
+            ]
+            return max(trained) if trained else None
         self._refit_dirty()
         if not self.kde_models:
             return None
@@ -237,6 +278,104 @@ class BOHBKDE(base_config_generator):
         bw = np.clip(bw, self.min_bandwidth, cap_discrete).astype(np.float32)
         return KDE(padded, mask, bw)
 
+    def _refit_propose_device(
+        self, budget: float, n: int
+    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """One-dispatch refit + proposal (``in_trace_refit=True``): upload
+        the raw observation buffers (pow2-padded, so growth recompiles
+        only per capacity doubling), fit + score + select in-trace, fetch
+        ``n`` proposal vectors (+scores on the XLA path). The KDE pair
+        never exists host-side and never round-trips."""
+        import time as _time
+
+        from hpbandster_tpu.ops.kde import refit_propose_batch_seeded
+
+        vecs = np.asarray(self.configs[budget], np.float64)
+        losses = np.asarray(self.losses[budget], np.float32)
+        n_obs = len(losses)
+        n_good, n_bad = self._trained_split(n_obs)
+        conditional = bool(self.configspace.get_conditions())
+        if not conditional:
+            # condition-free spaces carry no NaNs; scrub defensively so a
+            # foreign NaN cannot poison the mask-weighted fit
+            vecs = np.nan_to_num(vecs, nan=0.0)
+        cap = _pow2_capacity(n_obs, minimum=64)
+        buf_v = np.zeros((cap, vecs.shape[1]), np.float32)
+        buf_v[:n_obs] = vecs
+        buf_l = np.full(cap, np.inf, np.float32)
+        buf_l[:n_obs] = np.where(np.isnan(losses), np.inf, losses)
+        seed = np.uint32(self.rng.integers(2**32, dtype=np.uint32))
+        impute_seed = (
+            np.uint32(self.rng.integers(2**32, dtype=np.uint32))
+            if conditional else None
+        )
+        t0 = _time.monotonic()
+        if self.use_pallas:
+            from hpbandster_tpu.ops.pallas_kde import (
+                pallas_available,
+                pallas_refit_propose_batch_seeded,
+            )
+
+            out = self._refit_pallas_jit(
+                seed, buf_v, buf_l, np.int32(n_obs), np.int32(n_good),
+                np.int32(n_bad), n, impute_seed,
+                pallas_refit_propose_batch_seeded, not pallas_available(),
+            )
+            vecs_out, scores_out = np.asarray(out), None
+        else:
+            dev_vecs, dev_scores = refit_propose_batch_seeded(
+                seed, buf_v, buf_l, np.int32(n_obs), np.int32(n_good),
+                np.int32(n_bad), self._vartypes_dev, self._cards_dev, n,
+                self.num_samples, self.bandwidth_factor, self.min_bandwidth,
+                impute_seed=impute_seed,
+            )
+            vecs_out = np.asarray(dev_vecs)
+            scores_out = np.asarray(dev_scores)
+        # observability parity with the host fit: the refit happened (in-
+        # trace), the journal and the kde_refit_stall anomaly rule still
+        # see it
+        obs.emit(
+            obs.KDE_REFIT,
+            budget=budget, n_obs=n_obs, n_good=n_good, n_bad=n_bad,
+            duration_s=round(_time.monotonic() - t0, 6), in_trace=True,
+        )
+        obs.get_metrics().counter("kde.refits").inc()
+        return vecs_out, scores_out
+
+    def _refit_pallas_jit(
+        self, seed, buf_v, buf_l, count, n_good, n_bad, n, impute_seed,
+        fn, interpret,
+    ):
+        """One tracked-jit boundary around the Pallas refit+propose
+        pipeline (built once per generator; n/num_samples static)."""
+        if getattr(self, "_pallas_refit_fn", None) is None:
+            from functools import partial
+
+            from hpbandster_tpu.obs.runtime import tracked_jit
+
+            self._pallas_refit_fn = tracked_jit(
+                partial(
+                    fn,
+                    vartypes=self._vartypes_dev,
+                    cards=self._cards_dev,
+                    num_samples=self.num_samples,
+                    bandwidth_factor=self.bandwidth_factor,
+                    min_bandwidth=self.min_bandwidth,
+                    min_bandwidth_fit=self.min_bandwidth,
+                    interpret=interpret,
+                ),
+                name="pallas_refit_propose",
+                static_argnames=("n",),
+            )
+        if impute_seed is None:
+            return self._pallas_refit_fn(
+                seed, buf_v, buf_l, count, n_good, n_bad, n=n
+            )
+        return self._pallas_refit_fn(
+            seed, buf_v, buf_l, count, n_good, n_bad, n=n,
+            impute_seed=impute_seed,
+        )
+
     def _propose_batch_pallas(self, seed, good, bad, n: int) -> np.ndarray:
         """Pallas-scored proposals via the shared traced pipeline
         (``ops.pallas_kde.pallas_propose_batch_seeded``): generation,
@@ -276,8 +415,9 @@ class BOHBKDE(base_config_generator):
         self.kde_models.clear()
         self._device_kdes.clear()
         self._dirty_budgets.clear()
-        for budget in self.configs:
-            self._fit_kde_pair(budget)
+        if not self.in_trace_refit:  # in-trace mode refits at proposal time
+            for budget in self.configs:
+                self._fit_kde_pair(budget)
 
     # ------------------------------------------------------------- interface
     def new_result(self, job: Job, update_model: bool = True) -> None:
@@ -290,6 +430,10 @@ class BOHBKDE(base_config_generator):
         vec = self.configspace.to_vector(job.kwargs["config"])
         self.configs.setdefault(budget, []).append(vec)
         self.losses.setdefault(budget, []).append(loss)
+        if self.in_trace_refit:
+            # no host model to maintain: the fit happens inside the next
+            # proposal dispatch, over these recorded observations
+            return
         if update_model:
             self._fit_kde_pair(budget)
             self._dirty_budgets.discard(budget)
@@ -327,6 +471,15 @@ class BOHBKDE(base_config_generator):
                 ),
             }
         try:
+            if self.in_trace_refit:
+                # trickle twin of the batch path: fit + propose in one
+                # dispatch (n=1 is its own compiled shape, paid once)
+                vecs, scores = self._refit_propose_device(best_budget, 1)
+                cfg = self.configspace.from_vector(vecs[0])
+                return dict(cfg), self._model_pick_info(
+                    best_budget,
+                    None if scores is None else float(scores[0]),
+                )
             good, bad = self._device_kde_pair(best_budget)
             best_vec, _, scores = propose(
                 self._next_key(),
@@ -366,7 +519,27 @@ class BOHBKDE(base_config_generator):
         use_model = self.rng.uniform(size=n) >= self.random_fraction
         n_model = int(use_model.sum())
         out: List[Optional[Tuple[Dict[str, Any], Dict[str, Any]]]] = [None] * n
-        if n_model:
+        if n_model and self.in_trace_refit:
+            # one dispatch: refit + proposal over raw observation buffers
+            n_pad = _pow2_capacity(n_model, minimum=self.proposal_batch_size)
+            vecs_all, scores_all = self._refit_propose_device(
+                best_budget, n_pad
+            )
+            vecs = vecs_all[:n_model]
+            scores = None if scores_all is None else scores_all[:n_model]
+            k = 0
+            for i in range(n):
+                if use_model[i]:
+                    cfg = self.configspace.from_vector(vecs[k])
+                    out[i] = (
+                        dict(cfg),
+                        self._model_pick_info(
+                            best_budget,
+                            None if scores is None else float(scores[k]),
+                        ),
+                    )
+                    k += 1
+        elif n_model:
             good, bad = self._device_kde_pair(best_budget)
             # fixed batch size (pow2 growth above it): stage sizes vary per
             # bracket, and every distinct batch shape would otherwise be a

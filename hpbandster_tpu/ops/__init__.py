@@ -9,14 +9,23 @@ from hpbandster_tpu.ops.bracket import (  # noqa: F401
     sh_promotion_mask,
     sh_resample_mask,
 )
+from hpbandster_tpu.ops.buckets import (  # noqa: F401
+    BucketPlan,
+    BucketSet,
+    build_bucket_set,
+    make_bucketed_bracket_fn,
+    precompile_buckets,
+)
 from hpbandster_tpu.ops.kde import (  # noqa: F401
     KDE,
     LOG_PDF_FLOOR,
+    fit_kde_pair_masked,
     kde_logpdf,
     normal_reference_bandwidths,
     propose,
     propose_batch,
     propose_batch_seeded,
     propose_batch_seeded_scored,
+    refit_propose_batch_seeded,
     sample_around,
 )

@@ -136,8 +136,10 @@ def sh_promotion_mask_compiled():
     if _PROMOTION_JIT is None:
         from hpbandster_tpu.obs.runtime import tracked_jit
 
+        # donation declined explicitly (docs/perf_notes.md): the bool[n]
+        # mask output cannot alias the f32[n] losses input (dtype differs)
         _PROMOTION_JIT = tracked_jit(
-            sh_promotion_mask, name="sh_promotion_mask"
+            sh_promotion_mask, name="sh_promotion_mask", donate_argnums=()
         )
     return _PROMOTION_JIT
 

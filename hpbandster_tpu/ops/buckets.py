@@ -1,0 +1,487 @@
+"""Shape-bucketed fused brackets: a handful of programs for a whole sweep.
+
+The compile ledger (``obs/runtime.py``) proved what the fused per-bracket
+tier pays: ``make_fused_bracket_fn`` burns every bracket shape into its
+trace, so a multi-bracket HyperBand sweep compiles one XLA program per
+distinct ``(num_configs, budgets)`` — seven programs for the 36-bracket
+1..729 rotation, each tens of seconds on a cold cache. This module spends
+those ledger numbers: bracket shapes are padded up to a small GEOMETRIC
+bucket set, and per-stage survivor counts become *traced* inputs, so every
+bracket in a bucket shares ONE compiled program.
+
+Bucket geometry (:func:`build_bucket_set`):
+
+* **depths pair up**: adjacent present depths ``(d, d-1)`` share a bucket
+  aligned at the ladder TAIL (their budgets are suffixes of each other in
+  a HyperBand schedule). The shallower member enters at stage 1 and wastes
+  only the bucket's cheapest leading rung — a bounded ~1/depth overhead —
+  while halving the program count. Deeper merges are geometrically worse
+  (HyperBand rungs cost roughly equal device time), so pairing is the
+  default and the knob stops there.
+* **widths round up to powers of two** (floor 8) of the widest member at
+  each aligned rung, so one width profile covers the pair and future
+  schedules reusing the shapes hit the same executables.
+
+The bucketed kernel (:func:`fused_sh_bracket_bucketed`) reproduces
+``fused_sh_bracket``'s promotion semantics exactly — NaN (crashed) rows
+rank behind every clean loss and ahead of padding, ties break
+index-stably, survivors keep their original order — but the top-k widths
+are traced counts: promotion is a rank mask (the same double-argsort as
+``sh_promotion_mask``) followed by a static-width gather, not a static
+``top_k``. Rows beyond a stage's traced count are padding: evaluated
+(bounded waste, see above) but never promoted and never reported.
+
+Programs are AOT-compiled through ``tracked_jit``'s ``lower().compile()``
+proxy (:func:`precompile_buckets`), optionally on a background thread so
+the compile overlaps stage-0 sampling, and every compile lands in the
+process-wide ledger — the budget tests in ``tests/test_buckets.py`` and
+the bench budget gate read it back.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hpbandster_tpu.ops.bracket import BracketPlan
+from hpbandster_tpu.utils.lru import LRUCache
+
+__all__ = [
+    "BucketPlan",
+    "BucketSet",
+    "build_bucket_set",
+    "fused_sh_bracket_bucketed",
+    "make_bucketed_bracket_fn",
+    "precompile_buckets",
+    "slice_member_stages",
+]
+
+#: crashed (NaN) losses rank here: behind any real loss, ahead of the +inf
+#: padding rows — the same constant (and therefore the same ordering) as
+#: ops.fused._CRASH_RANK / the host sh_promotion_mask twin
+_CRASH_RANK = np.float32(3.0e38)
+
+
+class BucketPlan(NamedTuple):
+    """One compiled bucket: static per-stage WIDTHS + static budgets."""
+
+    #: padded row capacity at each stage (non-increasing, pow2, floor 8)
+    widths: Tuple[int, ...]
+    #: concrete budget per stage (a ladder suffix; eval fns may use it as
+    #: a static trip count, exactly like the unbucketed fused bracket)
+    budgets: Tuple[float, ...]
+
+    @property
+    def depth(self) -> int:
+        return len(self.widths)
+
+
+class BucketSet(NamedTuple):
+    """The bucket programs for a schedule + each shape's placement."""
+
+    buckets: Tuple[BucketPlan, ...]
+    #: (num_configs, budgets) -> (bucket_index, entry_stage)
+    assignment: Dict[Tuple, Tuple[int, int]]
+
+    def lookup(self, num_configs, budgets) -> Optional[Tuple[int, int]]:
+        key = (
+            tuple(int(n) for n in num_configs),
+            tuple(float(b) for b in budgets),
+        )
+        return self.assignment.get(key)
+
+
+def _pow2(n: int, floor: int = 8) -> int:
+    size = floor
+    while size < n:
+        size *= 2
+    return size
+
+
+def build_bucket_set(
+    plans: Sequence[BracketPlan],
+    *,
+    min_width: int = 8,
+    mesh_size: int = 1,
+) -> BucketSet:
+    """Group a schedule's bracket shapes into a small geometric bucket set.
+
+    Shapes group by depth, adjacent present depths pairing up (deepest
+    first); within a bucket, shapes align at the ladder TAIL (final budgets
+    coincide), the bucket's budgets are the deepest member's, and each
+    rung's width is the widest aligned member count rounded up to a power
+    of two (stage 0 additionally to a multiple of ``mesh_size``). A shape
+    whose budgets are not a suffix of its group's deepest member — plans
+    from a different ladder — falls back to its own singleton bucket
+    rather than mis-aligning.
+
+    Single-stage plans are excluded (nothing to fuse, nothing to compile).
+    """
+    shapes = sorted(
+        {
+            (
+                tuple(int(n) for n in p.num_configs),
+                tuple(float(b) for b in p.budgets),
+            )
+            for p in plans
+            if len(p.num_configs) >= 2
+        },
+        key=lambda s: (-len(s[1]), s[1], s[0]),
+    )
+    by_depth: Dict[int, List[Tuple]] = {}
+    for shape in shapes:
+        by_depth.setdefault(len(shape[1]), []).append(shape)
+
+    buckets: List[BucketPlan] = []
+    assignment: Dict[Tuple, Tuple[int, int]] = {}
+    depths = sorted(by_depth, reverse=True)
+    used: set = set()
+    for d in depths:
+        if d in used:
+            continue
+        group_depths = [d]
+        if (d - 1) in by_depth and (d - 1) not in used:
+            group_depths.append(d - 1)
+        used.update(group_depths)
+
+        # the bucket's budgets come from the deepest member; members whose
+        # budgets are not a suffix of them get singleton buckets instead
+        bucket_budgets = by_depth[d][0][1]
+        members: List[Tuple[Tuple, int]] = []  # (shape, entry)
+        for gd in group_depths:
+            for shape in by_depth[gd]:
+                entry = len(bucket_budgets) - len(shape[1])
+                if shape[1] == bucket_budgets[entry:]:
+                    members.append((shape, entry))
+                else:
+                    singleton = BucketPlan(
+                        widths=tuple(
+                            _pow2(int(n), min_width) for n in shape[0]
+                        ),
+                        budgets=shape[1],
+                    )
+                    singleton = _mesh_pad(singleton, mesh_size)
+                    assignment[shape] = (len(buckets), 0)
+                    buckets.append(singleton)
+
+        if not members:
+            continue
+        widths = [0] * len(bucket_budgets)
+        for shape, entry in members:
+            for s, n in enumerate(shape[0]):
+                widths[entry + s] = max(widths[entry + s], int(n))
+        # pow2 roundup of an (aligned-max) non-increasing profile stays
+        # non-increasing; the running max from the right guards the
+        # invariant against degenerate inputs anyway
+        widths = [_pow2(w, min_width) for w in widths]
+        for j in range(len(widths) - 2, -1, -1):
+            widths[j] = max(widths[j], widths[j + 1])
+        bucket = _mesh_pad(
+            BucketPlan(widths=tuple(widths), budgets=bucket_budgets),
+            mesh_size,
+        )
+        idx = len(buckets)
+        buckets.append(bucket)
+        for shape, entry in members:
+            assignment[shape] = (idx, entry)
+    return BucketSet(buckets=tuple(buckets), assignment=assignment)
+
+
+def _mesh_pad(bucket: BucketPlan, mesh_size: int) -> BucketPlan:
+    """Stage-0 width padded to a mesh multiple (only the input is sharded,
+    matching ``make_fused_bracket_fn``'s policy)."""
+    m = max(int(mesh_size), 1)
+    if m == 1 or bucket.widths[0] % m == 0:
+        return bucket
+    w0 = ((bucket.widths[0] + m - 1) // m) * m
+    return BucketPlan(widths=(w0,) + bucket.widths[1:], budgets=bucket.budgets)
+
+
+def fused_sh_bracket_bucketed(
+    eval_fn: Callable,
+    vectors,
+    counts,
+    bucket: BucketPlan,
+):
+    """One bucketed bracket, traceable under ``jit``.
+
+    ``vectors`` is ``f32[widths[0], d]`` (member rows first, zero-padded);
+    ``counts`` is ``i32[depth]`` — the member's TRUE per-stage config
+    counts, 0 for stages before its entry. Returns per-stage
+    ``(indices, losses)`` at bucket widths; rows past ``counts[t]`` are
+    padding (see :func:`slice_member_stages`).
+
+    Promotion reproduces ``fused_sh_bracket`` / ``sh_promotion_mask``
+    exactly (crash rank, index-stable ties, original-order survivors) with
+    the top-k width a traced count: rank < k masks survivors, a stable
+    index-keyed argsort packs them first, a static slice narrows to the
+    next stage's width. While a stage's count is 0 (pre-entry) the carry
+    is the identity head slice, so entering rows survive untouched.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    widths = bucket.widths
+    budgets = bucket.budgets
+    depth = len(widths)
+    counts = jnp.asarray(counts, jnp.int32)
+
+    def eval_stage(vecs, budget: float):
+        return jax.vmap(lambda v: eval_fn(v, budget))(vecs).astype(jnp.float32)
+
+    cur_vecs = vectors
+    cur_idx = jnp.arange(widths[0], dtype=jnp.int32)
+    out = []
+    for t in range(depth):
+        losses_t = eval_stage(cur_vecs, float(budgets[t]))
+        out.append((cur_idx, losses_t))
+        if t + 1 == depth:
+            break
+        w, w_next = widths[t], widths[t + 1]
+        rows = jnp.arange(w, dtype=jnp.int32)
+        valid = rows < counts[t]
+        key = jnp.where(jnp.isnan(losses_t), _CRASH_RANK, losses_t)
+        key = jnp.where(valid, key, jnp.inf)
+        # double argsort = value rank with index-stable ties, the same
+        # selection top_k makes (and sh_promotion_mask_np replays host-side)
+        ranks = jnp.argsort(jnp.argsort(key, stable=True), stable=True)
+        promote = (ranks < counts[t + 1]) & valid
+        # survivors first, original order among them — then the rest, so a
+        # static head slice is the gather (matches fused's sorted top_k)
+        order = jnp.argsort(jnp.where(promote, rows, w + rows), stable=True)
+        sel_ranked = order[:w_next]
+        sel_identity = jnp.arange(w_next, dtype=jnp.int32)
+        sel = jnp.where(counts[t] > 0, sel_ranked, sel_identity)
+        cur_vecs = cur_vecs[sel]
+        cur_idx = cur_idx[sel]
+    return out
+
+
+def slice_member_stages(
+    stages: List[Tuple], plan: BracketPlan, entry: int
+) -> List[Tuple]:
+    """Cut a bucket dispatch's stage list down to one member bracket's
+    results: bucket stage ``entry + s`` holds member stage ``s`` in its
+    first ``plan.num_configs[s]`` rows."""
+    out = []
+    for s, k in enumerate(plan.num_configs):
+        idx, losses = stages[entry + s]
+        out.append((idx[: int(k)], losses[: int(k)]))
+    return out
+
+
+#: process-wide compiled-bucket cache — same policy as ops.fused's
+#: _FUSED_FN_CACHE: a (objective, bucket, mesh) combination compiles once
+#: per process, bounded so throwaway closures cannot pin executables
+_BUCKET_FN_CACHE: LRUCache = LRUCache(maxsize=64)
+
+
+class _BucketRunner:
+    """One bucket's compiled program + dispatch/unpack plumbing.
+
+    The executable is built exactly once (lazily on first dispatch, or
+    ahead of time via :meth:`ensure_compiled` / :func:`precompile_buckets`)
+    through the tracked ``lower().compile()`` proxy, so the compile ledger
+    sees exactly one compile per bucket — the number the budget tests and
+    the bench gate assert on. Dispatches always run the AOT executable;
+    the jit wrapper itself is never called (that would compile a second,
+    untracked-by-AOT cache entry).
+    """
+
+    def __init__(self, eval_fn, bucket: BucketPlan, mesh=None, axis="config"):
+        from hpbandster_tpu.obs.runtime import tracked_jit
+
+        self.bucket = bucket
+        self.mesh = mesh
+        self.axis = axis
+        self._lock = threading.Lock()
+        self._compiled = None
+        self._dim: Optional[int] = None
+
+        def bracket(vectors, counts):
+            stages = fused_sh_bracket_bucketed(eval_fn, vectors, counts, bucket)
+            import jax.numpy as jnp
+
+            return (
+                jnp.concatenate([s[0] for s in stages]),
+                jnp.concatenate([s[1] for s in stages]),
+            )
+
+        jit_kwargs: Dict = {
+            # donation declined explicitly (docs/perf_notes.md): the
+            # packed (idx, loss) outputs cannot alias the [W0, d] vectors
+            # input, so donating it would only emit a per-compile warning
+            "donate_argnums": (),
+        }
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            shard = NamedSharding(mesh, PartitionSpec(axis))
+            rep = NamedSharding(mesh, PartitionSpec())
+            jit_kwargs["in_shardings"] = (shard, rep)
+        self._wrapper = tracked_jit(
+            bracket, name="fused_bucket", **jit_kwargs
+        )
+
+    # ------------------------------------------------------------- compile
+    def ensure_compiled(self, d: int):
+        """AOT-compile the bucket program for ``d``-dim vectors (idempotent,
+        thread-safe — the background precompiler and a dispatching executor
+        may race here)."""
+        with self._lock:
+            if self._compiled is not None:
+                if self._dim != int(d):
+                    raise ValueError(
+                        f"bucket program compiled for d={self._dim}, "
+                        f"asked for d={d}"
+                    )
+                return self._compiled
+            import jax
+            import jax.numpy as jnp
+
+            specs = (
+                jax.ShapeDtypeStruct((self.bucket.widths[0], int(d)), jnp.float32),
+                jax.ShapeDtypeStruct((self.bucket.depth,), jnp.int32),
+            )
+            self._compiled = self._wrapper.lower(*specs).compile()
+            self._dim = int(d)
+            return self._compiled
+
+    # ------------------------------------------------------------ dispatch
+    def dispatch(self, vectors: np.ndarray, counts: Sequence[int]):
+        """Launch one member bracket; returns packed DEVICE arrays without
+        blocking (callers overlap several brackets before fetching).
+
+        ``vectors`` is ``f32[n0, d]`` member rows (padded up here);
+        ``counts`` the member's true per-stage counts, entry-aligned
+        (length = bucket depth, leading zeros for pre-entry stages).
+        """
+        from hpbandster_tpu.obs.runtime import note_transfer
+
+        vectors = np.asarray(vectors, np.float32)
+        w0 = self.bucket.widths[0]
+        if vectors.shape[0] > w0:
+            raise ValueError(
+                f"{vectors.shape[0]} rows do not fit bucket width {w0}"
+            )
+        if vectors.shape[0] < w0:
+            vectors = np.concatenate(
+                [vectors, np.zeros((w0 - vectors.shape[0], vectors.shape[1]),
+                                   np.float32)]
+            )
+        counts = np.asarray(counts, np.int32)
+        if counts.shape != (self.bucket.depth,):
+            raise ValueError(
+                f"counts must be i32[{self.bucket.depth}], got {counts.shape}"
+            )
+        compiled = self.ensure_compiled(vectors.shape[1])
+        note_transfer("h2d", vectors.nbytes + counts.nbytes, buffers=2)
+        if self.mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            shard = NamedSharding(self.mesh, PartitionSpec(self.axis))
+            rep = NamedSharding(self.mesh, PartitionSpec())
+            vecs_host = vectors
+            counts_host = counts
+            vectors = jax.make_array_from_callback(
+                vecs_host.shape, shard, lambda idx: vecs_host[idx]
+            )
+            counts = jax.make_array_from_callback(
+                counts_host.shape, rep, lambda idx: counts_host[idx]
+            )
+        return compiled(vectors, counts)
+
+    def unpack(self, packed) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Blocking fetch of a dispatch's packed pair, cut back into
+        per-stage (idx, losses) at bucket widths."""
+        import jax
+
+        from hpbandster_tpu.obs.runtime import note_transfer
+
+        idx_flat, loss_flat = jax.device_get(tuple(packed))
+        note_transfer("d2h", idx_flat.nbytes + loss_flat.nbytes, buffers=2)
+        out, off = [], 0
+        for w in self.bucket.widths:
+            out.append((idx_flat[off:off + w], loss_flat[off:off + w]))
+            off += w
+        return out
+
+    def run_member(self, vectors: np.ndarray, plan: BracketPlan, entry: int):
+        """Dispatch + fetch one member bracket, returning its TRUE-shape
+        per-stage ``(indices, losses)`` — the drop-in equivalent of a
+        ``make_fused_bracket_fn`` runner call."""
+        counts = np.zeros(self.bucket.depth, np.int32)
+        for s, k in enumerate(plan.num_configs):
+            counts[entry + s] = int(k)
+        packed = self.dispatch(np.asarray(vectors, np.float32), counts)
+        return slice_member_stages(self.unpack(packed), plan, entry)
+
+
+def make_bucketed_bracket_fn(
+    eval_fn: Callable,
+    bucket: BucketPlan,
+    mesh=None,
+    axis: str = "config",
+) -> _BucketRunner:
+    """The (process-cached) runner for one bucket program."""
+    key = (eval_fn, bucket, mesh, axis)
+    runner = _BUCKET_FN_CACHE.get(key)
+    if runner is None:
+        runner = _BucketRunner(eval_fn, bucket, mesh=mesh, axis=axis)
+        _BUCKET_FN_CACHE[key] = runner
+    return runner
+
+
+class _Precompile:
+    """Handle over a (possibly background) bucket-set compilation."""
+
+    def __init__(self, runners: List[_BucketRunner], d: int):
+        self._runners = runners
+        self._d = int(d)
+        self._done = threading.Event()
+        self.errors: List[Exception] = []
+
+    def _work(self) -> None:
+        try:
+            for r in self._runners:
+                try:
+                    r.ensure_compiled(self._d)
+                except Exception as e:  # noqa: BLE001 — reported via wait()
+                    self.errors.append(e)
+        finally:
+            self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until every bucket is compiled; True when finished."""
+        return self._done.wait(timeout)
+
+
+def precompile_buckets(
+    eval_fn: Callable,
+    bucket_set: BucketSet,
+    d: int,
+    mesh=None,
+    axis: str = "config",
+    background: bool = True,
+) -> _Precompile:
+    """AOT-compile every bucket program through the tracked
+    ``lower().compile()`` proxy — in a daemon thread by default, so the
+    compile overlaps the optimizer's stage-0 sampling instead of
+    serializing in front of the first dispatch. Returns a handle whose
+    ``wait()`` blocks until the set is ready (dispatching earlier is safe:
+    the runner's own lock serializes on the in-flight compile)."""
+    runners = [
+        make_bucketed_bracket_fn(eval_fn, b, mesh=mesh, axis=axis)
+        for b in bucket_set.buckets
+    ]
+    handle = _Precompile(runners, d)
+    if background:
+        threading.Thread(
+            target=handle._work, daemon=True, name="bucket-precompile"
+        ).start()
+    else:
+        handle._work()
+    return handle

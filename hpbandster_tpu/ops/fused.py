@@ -167,8 +167,14 @@ def make_fused_bracket_fn(
             fused_sh_bracket(eval_fn, vectors, num_configs, budgets)
         )
 
+    # donation contract (docs/perf_notes.md): the packed (idx, loss)
+    # outputs cannot alias the [n0, d] vectors input, so donating it would
+    # be a warning-only no-op — declined explicitly. The state-threading
+    # donation lives where an alias exists (ops/sweep.py return_state).
     if mesh is None:
-        jitted_plain = tracked_jit(bracket, name="fused_bracket")
+        jitted_plain = tracked_jit(
+            bracket, name="fused_bracket", donate_argnums=()
+        )
 
         def dispatch(vectors):
             """Launch the bracket; returns packed DEVICE arrays without
@@ -183,7 +189,8 @@ def make_fused_bracket_fn(
         n_pad = ((n0 + m - 1) // m) * m
         shard = NamedSharding(mesh, PartitionSpec(axis))
         jitted = tracked_jit(
-            bracket, name="fused_bracket_sharded", in_shardings=(shard,)
+            bracket, name="fused_bracket_sharded", in_shardings=(shard,),
+            donate_argnums=(),
         )
 
         def dispatch(vectors):

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import math
 from functools import partial
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +38,9 @@ __all__ = [
     "propose",
     "propose_batch",
     "propose_batch_seeded_scored",
+    "impute_conditional_masked",
+    "fit_kde_pair_masked",
+    "refit_propose_batch_seeded",
 ]
 
 #: reference clips pdf values at 1e-32 before the ratio (SURVEY.md §3.4)
@@ -316,6 +319,152 @@ def propose_batch_seeded(
         seed, good, bad, vartypes, cards, n, num_samples, bandwidth_factor,
         min_bandwidth,
     )[0]
+
+
+def impute_conditional_masked(
+    key: jax.Array, data: jax.Array, cards: jax.Array
+) -> jax.Array:
+    """Device twin of ``BOHBKDE.impute_conditional_data``: every NaN
+    (inactive-dim) entry borrows the value of a uniformly random *active*
+    row of the same column; columns with no active rows fall back to a
+    random category (discrete) or uniform draw (continuous).
+
+    O(n·d): donors are drawn by inverse-CDF over each column's running
+    active count (no n x n materialization). Lived in ``ops/sweep.py``
+    until the in-trace refit op below needed it too — the sweep imports it
+    from here now, so the imputation scheme has exactly one definition."""
+    n, d = data.shape
+    isnan = jnp.isnan(data)
+    active = (~isnan).astype(jnp.int32)
+    cnt = jnp.cumsum(active, axis=0)  # [n, d] running donor count
+    total = cnt[-1, :]  # [d]
+    k_pick, k_fb = jax.random.split(key)
+    u = jax.random.uniform(k_pick, (n, d))
+    # r-th donor (1-indexed) per entry; searchsorted over the column's
+    # non-decreasing count finds its row
+    r = jnp.floor(u * jnp.maximum(total, 1)[None, :]).astype(jnp.int32) + 1
+    rows = jax.vmap(
+        lambda c, rr: jnp.searchsorted(c, rr, side="left"), in_axes=(1, 1),
+        out_axes=1,
+    )(cnt, r)
+    donated = jnp.take_along_axis(data, jnp.clip(rows, 0, n - 1), axis=0)
+
+    u_fb = jax.random.uniform(k_fb, (n, d))
+    cards_f = jnp.maximum(cards.astype(jnp.float32), 1.0)
+    disc = jnp.clip(jnp.floor(u_fb * cards_f), 0, cards_f - 1)
+    fallback = jnp.where(cards[None, :] > 0, disc, u_fb)
+
+    fill = jnp.where((total > 0)[None, :], donated, fallback)
+    return jnp.where(isnan, fill, data)
+
+
+def fit_kde_pair_masked(
+    vecs: jax.Array,
+    losses: jax.Array,
+    count: jax.Array,
+    n_good: jax.Array,
+    n_bad: jax.Array,
+    cards: jax.Array,
+    min_bandwidth: float,
+    impute_key=None,
+) -> Tuple[KDE, KDE]:
+    """Traced-count good/bad KDE fit over a full-capacity buffer.
+
+    ``vecs``/``losses`` are FULL capacity buffers (``f32[C, d]`` /
+    ``f32[C]``, empty slots carrying ``+inf`` loss); ``count`` / ``n_good``
+    / ``n_bad`` are traced i32 scalars. Split membership is a rank mask
+    over the loss-sorted buffer instead of a static slice — every KDE
+    primitive downstream (bandwidths, log-pdf, candidate sampling, the
+    Pallas scorer) is mask-weighted, so the fitted model is the same; only
+    observation COUNTS stay out of the compiled program. This is the one
+    definition behind both the dynamic-count fused sweep
+    (``ops/sweep.py``) and the in-trace refit+propose op below.
+    """
+    cap = vecs.shape[0]
+    order = jnp.argsort(losses, stable=True)  # +inf pads sort last
+    sorted_v = vecs[order]
+    rank = jnp.arange(cap, dtype=jnp.int32)
+    good_mask = rank < n_good
+    bad_mask = (rank >= count - n_bad) & (rank < count)
+    if impute_key is not None:
+        # conditional spaces: donor-impute each split side exactly like the
+        # static path, with non-members NaN'd out so they neither donate
+        # nor constrain (their filled values are then masked from the fit)
+        kg, kb = jax.random.split(impute_key)
+        good_data = impute_conditional_masked(
+            kg, jnp.where(good_mask[:, None], sorted_v, jnp.nan), cards
+        )
+        bad_data = impute_conditional_masked(
+            kb, jnp.where(bad_mask[:, None], sorted_v, jnp.nan), cards
+        )
+    else:
+        good_data = bad_data = sorted_v
+
+    def mk(data: jax.Array, mask: jax.Array) -> KDE:
+        mask = mask.astype(jnp.float32)
+        bw = normal_reference_bandwidths(data, mask, cards, min_bandwidth)
+        return KDE(data, mask, bw)
+
+    return mk(good_data, good_mask), mk(bad_data, bad_mask)
+
+
+# the observation buffers are rebuilt host-side per refit and never reread
+# by the caller, but they cannot alias the [n, d] proposal outputs, so
+# donation buys nothing here — declined explicitly (jit-donation contract,
+# docs/perf_notes.md)
+@partial(
+    tracked_jit, static_argnames=("n", "num_samples"), donate_argnums=()
+)
+def refit_propose_batch_seeded(
+    seed: jax.Array,
+    obs_v: jax.Array,
+    obs_l: jax.Array,
+    count: jax.Array,
+    n_good: jax.Array,
+    n_bad: jax.Array,
+    vartypes: jax.Array,
+    cards: jax.Array,
+    n: int,
+    num_samples: int = 64,
+    bandwidth_factor: float = 3.0,
+    min_bandwidth: float = 1e-3,
+    impute_seed: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """KDE refit + a whole stage of proposals in ONE device dispatch.
+
+    The host path (``models/bohb_kde.py`` default) fits the KDE pair in
+    numpy, uploads the fitted arrays, then runs the proposal kernel — the
+    refit state round-trips through the host every rung. This op keeps it
+    in-trace: raw observation buffers go up (``f32[C, d]`` vectors,
+    ``f32[C]`` losses, ``+inf`` in empty slots), the good/bad split,
+    bandwidths, candidate generation, scoring and the per-proposal argmax
+    all happen inside one compiled program, and only the selected
+    ``(f32[n, d], f32[n])`` proposals + scores come back.
+
+    ``count``/``n_good``/``n_bad`` are traced i32 (the caller runs the
+    reference's split arithmetic), so observation growth recompiles only
+    when the buffer capacity doubles. Pass ``impute_seed`` on conditional
+    spaces to donor-impute NaN dims in-trace (a distinct RNG consumer from
+    the host path's ``rng.choice`` — documented, like the dynamic sweep
+    tier).
+    """
+    impute_key = (
+        None if impute_seed is None else jax.random.key(impute_seed)
+    )
+    good, bad = fit_kde_pair_masked(
+        obs_v, obs_l, count, n_good, n_bad, cards, min_bandwidth,
+        impute_key=impute_key,
+    )
+    keys = jax.random.split(jax.random.key(seed), n)
+
+    def one(k):
+        best, _, scores = propose(
+            k, good, bad, vartypes, cards, num_samples, bandwidth_factor,
+            min_bandwidth,
+        )
+        return best, jnp.max(scores)
+
+    return jax.vmap(one)(keys)
 
 
 @partial(tracked_jit, static_argnames=("num_samples",))

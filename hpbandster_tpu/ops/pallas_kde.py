@@ -35,6 +35,7 @@ __all__ = [
     "pallas_score_candidates_traced",
     "pallas_propose_batch",
     "pallas_propose_batch_seeded",
+    "pallas_refit_propose_batch_seeded",
     "pallas_available",
 ]
 
@@ -269,6 +270,45 @@ def pallas_propose_batch_seeded(
 ) -> jax.Array:
     """:func:`pallas_propose_batch` keyed from one scalar seed (same key
     derivation as ``ops.kde.generate_candidates_seeded``)."""
+    return pallas_propose_batch(
+        jax.random.key(seed), good, bad, vartypes, cards, n, num_samples,
+        bandwidth_factor, min_bandwidth, interpret,
+    )
+
+
+def pallas_refit_propose_batch_seeded(
+    seed: jax.Array,
+    obs_v: jax.Array,
+    obs_l: jax.Array,
+    count: jax.Array,
+    n_good: jax.Array,
+    n_bad: jax.Array,
+    vartypes: jax.Array,
+    cards: jax.Array,
+    n: int,
+    num_samples: int = 64,
+    bandwidth_factor: float = 3.0,
+    min_bandwidth: float = 1e-3,
+    min_bandwidth_fit: float = 1e-3,
+    impute_seed=None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Pallas twin of ``ops.kde.refit_propose_batch_seeded``: the KDE
+    refit (good/bad split + bandwidths over raw observation buffers, all
+    traced counts) AND the fused-kernel acquisition scoring happen in one
+    compiled dispatch — the refit state never visits the host. Returns
+    the selected proposals ``f32[n, d]`` (the Pallas pipeline is
+    score-less on the host side, like :func:`pallas_propose_batch`).
+    """
+    from hpbandster_tpu.ops.kde import fit_kde_pair_masked
+
+    impute_key = (
+        None if impute_seed is None else jax.random.key(impute_seed)
+    )
+    good, bad = fit_kde_pair_masked(
+        obs_v, obs_l, count, n_good, n_bad, cards, min_bandwidth_fit,
+        impute_key=impute_key,
+    )
     return pallas_propose_batch(
         jax.random.key(seed), good, bad, vartypes, cards, n, num_samples,
         bandwidth_factor, min_bandwidth, interpret,

@@ -41,7 +41,13 @@ import numpy as np
 from hpbandster_tpu.obs.runtime import tracked_jit
 from hpbandster_tpu.ops.bracket import BracketPlan
 from hpbandster_tpu.ops.fused import fused_sh_bracket, _pack_stages
-from hpbandster_tpu.ops.kde import KDE, normal_reference_bandwidths, propose
+from hpbandster_tpu.ops.kde import (
+    KDE,
+    fit_kde_pair_masked,
+    impute_conditional_masked,
+    normal_reference_bandwidths,
+    propose,
+)
 
 __all__ = ["SpaceCodec", "build_space_codec", "quantize_unit", "random_unit",
            "compile_active_mask", "compile_forbidden_mask",
@@ -492,6 +498,34 @@ def compile_forbidden_mask(configspace, codec: SpaceCodec):
     return forbidden_fn
 
 
+def _sweep_donation_safe() -> bool:
+    """Whether the state-threading sweep may donate its warm buffers.
+
+    On this jax (0.4.37) the CPU PJRT backend intermittently corrupts the
+    heap when a donated dict-pytree aliases the returned state after heavy
+    allocator churn — bisected empirically: 3/6 suite runs died in
+    malloc_consolidate/SIGSEGV with donation on, 0/6 with it off, same
+    program otherwise. The state thread itself (keeping the buffers
+    device-resident between chunks) is safe everywhere and carries the
+    transfer win; donation only adds the in-place alias, so it enables
+    where accelerator backends handle aliasing (TPU/GPU) and stays off on
+    CPU. ``HPB_SWEEP_DONATE=1``/``0`` forces either way (a chip run that
+    reproduces the corruption can switch it off without a patch).
+    """
+    import os
+
+    env = os.environ.get("HPB_SWEEP_DONATE", "")
+    if env in ("0", "1"):
+        return env == "1"
+    import jax
+
+    try:
+        return jax.default_backend() != "cpu"
+    # no backend at all: the jit below would fail first; stay undonated
+    except Exception:  # graftlint: disable=swallowed-exception — probe; donation defaults off when the backend is unknowable
+        return False
+
+
 class SweepBracketOutput(NamedTuple):
     """Per-bracket device outputs of the fused sweep."""
 
@@ -505,39 +539,9 @@ class SweepBracketOutput(NamedTuple):
     loss_packed: jax.Array
 
 
-def _impute_conditional_device(
-    key: jax.Array, data: jax.Array, cards: jax.Array
-) -> jax.Array:
-    """Device twin of ``BOHBKDE.impute_conditional_data``: every NaN
-    (inactive-dim) entry borrows the value of a uniformly random *active*
-    row of the same column; columns with no active rows fall back to a
-    random category (discrete) or uniform draw (continuous).
-
-    O(n·d): donors are drawn by inverse-CDF over each column's running
-    active count (no n x n materialization)."""
-    n, d = data.shape
-    isnan = jnp.isnan(data)
-    active = (~isnan).astype(jnp.int32)
-    cnt = jnp.cumsum(active, axis=0)  # [n, d] running donor count
-    total = cnt[-1, :]  # [d]
-    k_pick, k_fb = jax.random.split(key)
-    u = jax.random.uniform(k_pick, (n, d))
-    # r-th donor (1-indexed) per entry; searchsorted over the column's
-    # non-decreasing count finds its row
-    r = jnp.floor(u * jnp.maximum(total, 1)[None, :]).astype(jnp.int32) + 1
-    rows = jax.vmap(
-        lambda c, rr: jnp.searchsorted(c, rr, side="left"), in_axes=(1, 1),
-        out_axes=1,
-    )(cnt, r)
-    donated = jnp.take_along_axis(data, jnp.clip(rows, 0, n - 1), axis=0)
-
-    u_fb = jax.random.uniform(k_fb, (n, d))
-    cards_f = jnp.maximum(cards.astype(jnp.float32), 1.0)
-    disc = jnp.clip(jnp.floor(u_fb * cards_f), 0, cards_f - 1)
-    fallback = jnp.where(cards[None, :] > 0, disc, u_fb)
-
-    fill = jnp.where((total > 0)[None, :], donated, fallback)
-    return jnp.where(isnan, fill, data)
+#: device imputation moved to ops/kde.py (the in-trace refit op needs it
+#: too); the old private name stays importable for existing callers
+_impute_conditional_device = impute_conditional_masked
 
 
 def _fit_kde_pair_device(
@@ -570,54 +574,10 @@ def _fit_kde_pair_device(
     return mk(good), mk(bad)
 
 
-def _fit_kde_pair_dynamic(
-    vecs: jax.Array,
-    losses: jax.Array,
-    count: jax.Array,
-    n_good: jax.Array,
-    n_bad: jax.Array,
-    cards: jax.Array,
-    min_bandwidth: float,
-    impute_key: Optional[jax.Array] = None,
-) -> Tuple[KDE, KDE]:
-    """Traced-count twin of :func:`_fit_kde_pair_device`.
-
-    ``vecs``/``losses`` are FULL capacity buffers (``f32[C, d]`` /
-    ``f32[C]``, empty slots carrying ``+inf`` loss); ``count`` / ``n_good``
-    / ``n_bad`` are traced i32 scalars. Split membership becomes a rank
-    mask over the loss-sorted buffer instead of a static slice — every KDE
-    primitive downstream (bandwidths, log-pdf, candidate sampling, the
-    Pallas scorer) is already mask-weighted, so the fitted model is the
-    same; only observation COUNTS stop being burned into the compiled
-    program (the point: chunked/warm-started sweeps reuse one executable
-    as observations accumulate, see ``make_fused_sweep_fn``).
-    """
-    cap = vecs.shape[0]
-    order = jnp.argsort(losses, stable=True)  # +inf pads sort last
-    sorted_v = vecs[order]
-    rank = jnp.arange(cap, dtype=jnp.int32)
-    good_mask = rank < n_good
-    bad_mask = (rank >= count - n_bad) & (rank < count)
-    if impute_key is not None:
-        # conditional spaces: donor-impute each split side exactly like the
-        # static path, with non-members NaN'd out so they neither donate
-        # nor constrain (their filled values are then masked from the fit)
-        kg, kb = jax.random.split(impute_key)
-        good_data = _impute_conditional_device(
-            kg, jnp.where(good_mask[:, None], sorted_v, jnp.nan), cards
-        )
-        bad_data = _impute_conditional_device(
-            kb, jnp.where(bad_mask[:, None], sorted_v, jnp.nan), cards
-        )
-    else:
-        good_data = bad_data = sorted_v
-
-    def mk(data: jax.Array, mask: jax.Array) -> KDE:
-        mask = mask.astype(jnp.float32)
-        bw = normal_reference_bandwidths(data, mask, cards, min_bandwidth)
-        return KDE(data, mask, bw)
-
-    return mk(good_data, good_mask), mk(bad_data, bad_mask)
+#: the traced-count fit moved to ops/kde.py (fit_kde_pair_masked) so the
+#: in-trace refit+propose op and this sweep share one definition; the old
+#: private name stays importable (tests/test_kde_oracle.py uses it)
+_fit_kde_pair_dynamic = fit_kde_pair_masked
 
 
 def make_fused_sweep_fn(
@@ -643,6 +603,7 @@ def make_fused_sweep_fn(
     max_forbidden_retries: int = 8,
     dynamic_counts: bool = False,
     capacities: Optional[dict] = None,
+    return_state: bool = False,
 ) -> Callable[..., List[SweepBracketOutput]]:
     """Trace + jit the whole sweep; returns ``fn(seed[, warm_v, warm_l])``.
 
@@ -678,10 +639,29 @@ def make_fused_sweep_fn(
     cost the chunked tier accepts for compile reuse. ``capacities``
     (budget -> slots, must cover warm + every plan's additions) pins the
     buffer shapes so all chunks of one run agree on them.
+
+    ``return_state=True`` (dynamic tier only) makes the jitted fn ALSO
+    return the end-of-sweep observation state ``(obs_v, obs_l, counts)``
+    — the same pytrees the warm inputs arrived as — so a chunked driver
+    can thread the state device-to-device across chunk boundaries: the
+    warm observation buffers stop round-tripping through the host (no
+    h2d re-upload per chunk), the compile/transfer tax the runtime
+    telemetry measured (ROADMAP). On accelerator backends the warm
+    inputs are additionally DONATED to the returned state
+    (``donate_argnums`` — XLA aliases each buffer to its updated twin in
+    place); on CPU donation stays off (:func:`_sweep_donation_safe` — a
+    jax 0.4.37 PJRT heap-corruption hazard, bisected empirically). When
+    donation is active the inputs are CONSUMED per call; pass fresh
+    arrays (or the previous call's returned state) each time.
     """
     d = int(codec.kind.shape[0])
     if forbidden_fn is not None and fallback_vector is None:
         raise ValueError("forbidden_fn requires a fallback_vector")
+    if return_state and not dynamic_counts:
+        raise ValueError(
+            "return_state=True requires dynamic_counts=True: the static "
+            "tier burns counts into the trace, there is no reusable state"
+        )
     min_pts = (d + 1) if min_points_in_model is None else max(int(min_points_in_model), d + 1)
     plans = [BracketPlan(tuple(p.num_configs), tuple(p.budgets)) for p in plans]
     warm_counts = {float(b): int(n) for b, n in (warm_counts or {}).items() if n > 0}
@@ -971,9 +951,25 @@ def make_fused_sweep_fn(
                     out_vectors[:n0], mb_mask, idx_packed, loss_packed
                 )
             )
+        if return_state:
+            # the donated warm inputs alias these outputs buffer-for-buffer
+            # (same pytree structure, shapes, dtypes) — the in-place state
+            # thread chunked drivers hand back to the next call
+            return outputs, (obs_v, obs_l, counts)
         return outputs
 
     from hpbandster_tpu.parallel.mesh import is_multiprocess_mesh
+
+    # buffer-donation contract (docs/perf_notes.md): the warm observation
+    # buffers are donated exactly when the call returns the updated state
+    # they can alias (the dynamic chunked thread) AND the backend handles
+    # aliasing safely. Elsewhere the outputs never match the input shapes,
+    # so donation would be a no-op warning — declined explicitly.
+    donate = (
+        (1, 2, 3)
+        if (dynamic_counts and return_state and _sweep_donation_safe())
+        else ()
+    )
 
     if is_multiprocess_mesh(mesh):
         # DCN tier (VERDICT r3 #6): the mesh spans several jax.distributed
@@ -988,6 +984,7 @@ def make_fused_sweep_fn(
 
         rep = NamedSharding(mesh, PartitionSpec())
         return tracked_jit(
-            sweep, name="fused_sweep_spmd", in_shardings=rep, out_shardings=rep
+            sweep, name="fused_sweep_spmd", in_shardings=rep,
+            out_shardings=rep, donate_argnums=donate,
         )
-    return tracked_jit(sweep, name="fused_sweep")
+    return tracked_jit(sweep, name="fused_sweep", donate_argnums=donate)

@@ -35,6 +35,7 @@ class BOHB(Master):
         min_bandwidth: float = 1e-3,
         seed: Optional[int] = None,
         iteration_class: type = SuccessiveHalving,
+        in_trace_refit: Optional[bool] = None,
         **kwargs: Any,
     ):
         if configspace is None:
@@ -48,6 +49,7 @@ class BOHB(Master):
             bandwidth_factor=bandwidth_factor,
             min_bandwidth=min_bandwidth,
             seed=seed,
+            in_trace_refit=in_trace_refit,
         )
         super().__init__(config_generator=cg, **kwargs)
         self.iteration_class = iteration_class
@@ -73,6 +75,15 @@ class BOHB(Master):
                 "bandwidth_factor": bandwidth_factor,
                 "min_bandwidth": min_bandwidth,
             }
+        )
+
+    def iteration_plan(self, iteration: int):
+        """The bracket shape global iteration ``iteration`` WILL run —
+        computable before any sampling, so ``Master.run`` can announce the
+        remaining schedule to shape-bucketing executors
+        (``BatchedExecutor.prepare_schedule``)."""
+        return hyperband_bracket(
+            iteration, self.min_budget, self.max_budget, self.eta
         )
 
     def get_next_iteration(

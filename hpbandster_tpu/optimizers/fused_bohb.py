@@ -289,8 +289,10 @@ class FusedBOHB:
         if dynamic:
             # the whole point of the dynamic tier: observation counts are
             # traced inputs, so they must NOT key the executable — only the
-            # buffer capacities (shapes) do
-            obs_term = ("dynamic", tuple(sorted(caps.items())))
+            # buffer capacities (shapes) do. "state" marks the
+            # return_state/donated executable this driver always builds
+            # (a plain dynamic sweep built elsewhere must not collide).
+            obs_term = ("dynamic", "state", tuple(sorted(caps.items())))
         else:
             warm_counts = {b: len(l) for b, l in self._warm_l.items()}
             obs_term = tuple(sorted(warm_counts.items()))
@@ -337,6 +339,10 @@ class FusedBOHB:
             fallback_vector=self._fallback_vector,
             dynamic_counts=dynamic,
             capacities=caps,
+            # the dynamic tier returns (and the warm inputs donate into)
+            # the updated observation state, so consecutive chunks thread
+            # it device-to-device instead of re-uploading warm buffers
+            return_state=dynamic,
         )
 
     def _sweep_compiled(self, plans, example_args, dynamic=False, caps=None):
@@ -448,6 +454,15 @@ class FusedBOHB:
         #: executes the next chunk instead of serializing with it
         pending_replay = None
         overlap_s = None
+        #: device-resident observation state threaded between dynamic
+        #: chunks (the return_state/donation contract, ops/sweep.py): the
+        #: previous chunk's returned (obs_v, obs_l, counts) pytrees feed
+        #: the next call directly — donated, so XLA updates the buffers in
+        #: place and the warm state never round-trips through the host.
+        #: Invalidated when a capacity bucket doubles (shapes changed);
+        #: the host fold (_accumulate_obs) then rebuilds identical values.
+        dev_state = None
+        dev_caps = None
 
         def _flush_replay():
             """Idempotent: runs the deferred replay exactly once. Clears
@@ -491,46 +506,76 @@ class FusedBOHB:
                         b: 1 << max(int(n) - 1, 255).bit_length()
                         for b, n in run_caps.items()
                     }
-                    warm_v_pad, warm_l_pad, warm_n = {}, {}, {}
-                    for b, cap in run_caps.items():
-                        v = self._warm_v.get(b)
-                        n = 0 if v is None else len(v)
-                        buf_v = np.zeros((cap, d), np.float32)
-                        buf_l = np.full(cap, np.inf, np.float32)
-                        if n:
-                            buf_v[:n] = v
-                            buf_l[:n] = self._warm_l[b]
-                        warm_v_pad[b] = buf_v
-                        warm_l_pad[b] = buf_l
-                        warm_n[b] = np.int32(n)
-                    args = (seed, warm_v_pad, warm_l_pad, warm_n)
+                    if dev_state is not None and run_caps == dev_caps:
+                        # same buffer shapes: hand the previous chunk's
+                        # device state straight back — zero warm-state
+                        # bytes cross the host link
+                        args = (seed,) + dev_state
+                    else:
+                        warm_v_pad, warm_l_pad, warm_n = {}, {}, {}
+                        for b, cap in run_caps.items():
+                            v = self._warm_v.get(b)
+                            n = 0 if v is None else len(v)
+                            buf_v = np.zeros((cap, d), np.float32)
+                            buf_l = np.full(cap, np.inf, np.float32)
+                            if n:
+                                buf_v[:n] = v
+                                buf_l[:n] = self._warm_l[b]
+                            warm_v_pad[b] = buf_v
+                            warm_l_pad[b] = buf_l
+                            warm_n[b] = np.int32(n)
+                        args = (seed, warm_v_pad, warm_l_pad, warm_n)
+                        dev_state = None  # stale shapes: never reuse
                 else:
                     args = (
                         (seed, self._warm_v, self._warm_l)
                         if self._warm_l else (seed,)
                     )
+                # the budget gate's transfer ledger: bytes the host link
+                # actually carries this chunk — measured BEFORE any
+                # to_global conversion below wraps the numpy leaves in jax
+                # Arrays (measuring after would read 0 on the DCN tier).
+                # Device-resident state leaves cost nothing: that is the
+                # state-threading win.
+                upload_bytes = sum(
+                    int(getattr(l, "nbytes", 0))
+                    for l in jax.tree_util.tree_leaves(args)
+                    if not isinstance(l, jax.Array)
+                )
                 if multiprocess:
                     # DCN tier: host-local numpy args become GLOBAL replicated
                     # arrays (every rank holds identical values — the SPMD
                     # drivers run the same deterministic control flow), matching
-                    # the sweep executable's replicated in_shardings
+                    # the sweep executable's replicated in_shardings. Leaves
+                    # that are already jax Arrays (the threaded device state)
+                    # pass through untouched — they carry the right sharding
+                    # from the previous call's out_shardings.
                     from jax.sharding import NamedSharding, PartitionSpec
 
                     rep = NamedSharding(self.mesh, PartitionSpec())
 
                     def to_global(x):
+                        if isinstance(x, jax.Array):
+                            return x
                         arr = np.asarray(x)
                         return jax.make_array_from_callback(
                             arr.shape, rep, lambda idx: arr[idx]
                         )
 
                     args = jax.tree.map(to_global, args)
+                from hpbandster_tpu.obs.runtime import note_transfer
+
+                note_transfer("h2d", upload_bytes)
                 with trace(profile_dir):
                     compiled, compile_s, cache_hit = self._sweep_compiled(
                         tuple(chunk_plans), args, dynamic=dynamic, caps=run_caps
                     )
                     t_exec = time.perf_counter()
                     raw = compiled(*args)  # async dispatch
+                    if dynamic:
+                        # keep the updated observation state ON DEVICE for
+                        # the next chunk; only bracket outputs are fetched
+                        raw, new_state = raw
                     # pipelining: the previous chunk's bookkeeping replays
                     # HERE, concurrent with this chunk's device execution
                     _flush_replay()
@@ -540,6 +585,13 @@ class FusedBOHB:
                     # OVERSTATES device-busy seconds, so derived MFU reads
                     # conservative; replay_overlap_s makes it attributable.
                     execute_s = time.perf_counter() - t_exec
+                    if dynamic:
+                        dev_state, dev_caps = new_state, run_caps
+                note_transfer(
+                    "d2h",
+                    sum(int(l.nbytes)
+                        for l in jax.tree_util.tree_leaves(outputs)),
+                )
             finally:
                 # any failure above (arg building, a bucket-doubling
                 # recompile, dispatch, fetch) must still land the COMPLETED
@@ -571,6 +623,9 @@ class FusedBOHB:
                 "compile_cache_hit": cache_hit,
                 "execute_fetch_s": round(execute_s, 4),
                 "dynamic_counts": bool(dynamic),
+                # where this chunk's warm observations came from: 0 bytes
+                # uploaded = the donated device thread carried them
+                "warm_upload_bytes": int(upload_bytes),
             }
             if overlap_s is not None:
                 # host replay of the PRIOR chunk that ran inside this

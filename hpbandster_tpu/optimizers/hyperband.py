@@ -53,6 +53,14 @@ class HyperBand(Master):
             }
         )
 
+    def iteration_plan(self, iteration: int):
+        """Bracket shape for iteration ``iteration``, ahead of sampling —
+        the schedule-announcement seam (see ``Master.run`` /
+        ``BatchedExecutor.prepare_schedule``)."""
+        return hyperband_bracket(
+            iteration, self.min_budget, self.max_budget, self.eta
+        )
+
     def get_next_iteration(
         self, iteration: int, iteration_kwargs: Dict[str, Any]
     ) -> SuccessiveHalving:

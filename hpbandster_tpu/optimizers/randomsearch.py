@@ -49,6 +49,18 @@ class RandomSearch(Master):
             }
         )
 
+    def iteration_plan(self, iteration: int):
+        """Single-stage plan (never fused/bucketed, but the announcement
+        seam stays uniform across optimizers)."""
+        from hpbandster_tpu.ops.bracket import BracketPlan
+
+        base = hyperband_bracket(
+            iteration, self.min_budget, self.max_budget, self.eta
+        )
+        return BracketPlan(
+            num_configs=(base.num_configs[0],), budgets=(self.max_budget,)
+        )
+
     def get_next_iteration(
         self, iteration: int, iteration_kwargs: Dict[str, Any]
     ) -> SuccessiveHalving:

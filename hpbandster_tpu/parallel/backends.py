@@ -103,13 +103,17 @@ class VmapBackend:
             # (XLA inserts the all-gather; losses are tiny) — a sharded
             # output would not be addressable outside its home process
             out = rep if self._multiprocess else shard
+            # donation contract (docs/perf_notes.md): the f32[n] losses
+            # output cannot alias the [n, d] batch input — declined
+            # explicitly rather than warned about per dispatch
             return tracked_jit(
                 batch_fn,
                 name="vmap_batch_sharded",
                 in_shardings=(shard, rep),
                 out_shardings=out,
+                donate_argnums=(),
             )
-        return tracked_jit(batch_fn, name="vmap_batch")
+        return tracked_jit(batch_fn, name="vmap_batch", donate_argnums=())
 
     def evaluate(self, vectors: np.ndarray, budget: float) -> np.ndarray:
         """``f32[n, d]`` config vectors -> ``f32[n]`` losses (NaN = crashed)."""

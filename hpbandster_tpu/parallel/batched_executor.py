@@ -48,11 +48,26 @@ class BatchedExecutor:
         configspace: ConfigurationSpace,
         fuse_brackets: bool = True,
         parallel_brackets: int = 1,
+        bucket_brackets: bool = True,
         logger: Optional[logging.Logger] = None,
     ):
+        from hpbandster_tpu.utils.compile_cache import (
+            enable_persistent_compile_cache,
+        )
+
+        # the executor IS a device-program factory: warm the persistent
+        # XLA cache before the first compile (idempotent; HPB_XLA_CACHE=0
+        # opts out — docs/perf_notes.md "Persistent compile cache")
+        enable_persistent_compile_cache()
         self.backend = backend
         self.configspace = configspace
         self.fuse_brackets = bool(fuse_brackets) and hasattr(backend, "eval_fn")
+        #: shape-bucketed fused brackets (ops/buckets.py): when the Master
+        #: announces the remaining schedule (prepare_schedule), its bracket
+        #: shapes pad up to a small geometric bucket set compiled ONCE per
+        #: bucket — and AOT-precompiled in the background, overlapped with
+        #: stage-0 sampling — instead of one program per shape
+        self.bucket_brackets = bool(bucket_brackets) and self.fuse_brackets
         # >1 pipelines brackets: bracket k+1's stage-0 wave is sampled (from
         # a one-bracket-stale model — the reference's own asynchrony) and
         # dispatched before bracket k's results are fetched, overlapping
@@ -67,6 +82,13 @@ class BatchedExecutor:
         #: (num_configs, budgets) -> compiled fused bracket fn
         self._fused_fns: Dict[Tuple, Callable] = {}
         self.fused_brackets_run = 0
+        #: of which, brackets served by a shared bucket program
+        self.bucketed_brackets_run = 0
+        #: every plan prepare_schedule has seen (the bucket set rebuilds
+        #: over the union, so a second run() widens rather than resets)
+        self._bucket_plans: List = []
+        self._bucket_set = None
+        self._bucket_precompile = None
 
     # -------------------------------------------------------- executor seam
     def start(self, new_result_callback, new_worker_callback) -> None:
@@ -109,6 +131,74 @@ class BatchedExecutor:
         # (see BOHBKDE._dirty_budgets for the conditional-space RNG caveat)
         self._new_result_callback(job, update_model=False)
 
+    # -------------------------------------------------------- bucketed path
+    def prepare_schedule(self, plans) -> None:
+        """Master.run seam: the remaining schedule's bracket shapes, known
+        before any sampling starts. Builds the geometric bucket set over
+        every plan seen so far and kicks off a BACKGROUND AOT compile of
+        the bucket programs (``ops/buckets.py``), so the compile overlaps
+        the optimizer's stage-0 sampling instead of serializing in front
+        of the first dispatch. Safe to skip entirely — brackets then fall
+        back to one compiled program per shape, exactly as before."""
+        if not self.bucket_brackets:
+            return
+        from hpbandster_tpu.ops.buckets import (
+            build_bucket_set,
+            precompile_buckets,
+        )
+
+        fusable = [p for p in plans if len(p.num_configs) >= 2]
+        if not fusable:
+            return
+        self._bucket_plans.extend(fusable)
+        mesh = getattr(self.backend, "mesh", None)
+        axis = getattr(self.backend, "axis", "config")
+        # pad stage-0 widths to the SHARDED axis size only — on a 2-D
+        # ('config', 'model') mesh the model axis replicates the batch, so
+        # padding to the total device count would evaluate dead rows
+        mesh_size = 1
+        if mesh is not None:
+            mesh_size = int(dict(mesh.shape).get(axis, 1))
+        self._bucket_set = build_bucket_set(
+            self._bucket_plans, mesh_size=mesh_size
+        )
+        self._bucket_precompile = precompile_buckets(
+            self.backend.eval_fn,
+            self._bucket_set,
+            d=self.configspace.dim,
+            mesh=mesh,
+            axis=axis,
+            background=True,
+        )
+        self.logger.debug(
+            "bucket set prepared: %d shapes -> %d programs",
+            len(self._bucket_set.assignment), len(self._bucket_set.buckets),
+        )
+
+    def _bucket_runner_for(self, info):
+        """The (runner, plan, entry) serving this bracket shape, or None
+        when bucketing is off / unprepared / does not cover the shape."""
+        if self._bucket_set is None:
+            return None
+        placed = self._bucket_set.lookup(info["num_configs"], info["budgets"])
+        if placed is None:
+            return None
+        from hpbandster_tpu.ops.bracket import BracketPlan
+        from hpbandster_tpu.ops.buckets import make_bucketed_bracket_fn
+
+        bucket_idx, entry = placed
+        runner = make_bucketed_bracket_fn(
+            self.backend.eval_fn,
+            self._bucket_set.buckets[bucket_idx],
+            mesh=getattr(self.backend, "mesh", None),
+            axis=getattr(self.backend, "axis", "config"),
+        )
+        plan = BracketPlan(
+            num_configs=tuple(info["num_configs"]),
+            budgets=tuple(info["budgets"]),
+        )
+        return runner, plan, entry
+
     # ---------------------------------------------------------- fused path
     def _try_fuse(self, jobs: List[Job]) -> Optional[List[Job]]:
         """Fuse every complete stage-0 bracket wave found in ``jobs``.
@@ -140,15 +230,6 @@ class BatchedExecutor:
             if not complete:
                 leftovers.extend(gjobs)
                 continue
-            shape_key = (info["num_configs"], info["budgets"])
-            if shape_key not in self._fused_fns:
-                self._fused_fns[shape_key] = make_fused_bracket_fn(
-                    self.backend.eval_fn,
-                    info["num_configs"],
-                    info["budgets"],
-                    mesh=getattr(self.backend, "mesh", None),
-                    axis=getattr(self.backend, "axis", "config"),
-                )
             jobs_sorted = sorted(gjobs, key=lambda j: j.id)
             vectors = np.stack(
                 [
@@ -160,31 +241,79 @@ class BatchedExecutor:
             ).astype(np.float32)
             for j in jobs_sorted:
                 j.time_it("started")
-            try:
-                # the dispatch span brackets the tracked-jit boundary
-                # (ops/fused.py): a first-wave tick here that dwarfs the
-                # steady state is compile time, and the xla_compile event
-                # the tracker journals says so explicitly
-                with obs.span(
-                    "fused_dispatch", iteration=iteration, n=len(jobs_sorted)
-                ):
-                    packed = self._fused_fns[shape_key].dispatch(vectors)
-            except Exception as e:  # contain: only THIS bracket's wave crashes
-                self._crash_wave(jobs_sorted, e, "fused dispatch")
-                crashed = True
-                continue
-            dispatched.append((iteration, info, jobs_sorted, packed))
+
+            # bucketed first: the shape shares a precompiled bucket program
+            # (ops/buckets.py) when the Master announced the schedule. Any
+            # bucketed failure falls back to the per-shape path — bucketing
+            # is an optimization, never a semantics (or liveness) change.
+            fetch = None
+            bucketed = self._bucket_runner_for(info)
+            if bucketed is not None:
+                runner, plan, entry = bucketed
+                counts = np.zeros(runner.bucket.depth, np.int32)
+                for s, k in enumerate(plan.num_configs):
+                    counts[entry + s] = int(k)
+                try:
+                    with obs.span(
+                        "fused_dispatch", iteration=iteration,
+                        n=len(jobs_sorted), bucketed=True,
+                    ):
+                        packed = runner.dispatch(vectors, counts)
+                    from hpbandster_tpu.ops.buckets import slice_member_stages
+
+                    fetch = (
+                        lambda packed=packed, runner=runner, plan=plan,
+                        entry=entry: slice_member_stages(
+                            runner.unpack(packed), plan, entry
+                        )
+                    )
+                    self.bucketed_brackets_run += 1
+                except Exception:
+                    self.logger.exception(
+                        "bucketed dispatch failed; falling back to the "
+                        "per-shape fused program"
+                    )
+
+            if fetch is None:
+                shape_key = (info["num_configs"], info["budgets"])
+                if shape_key not in self._fused_fns:
+                    self._fused_fns[shape_key] = make_fused_bracket_fn(
+                        self.backend.eval_fn,
+                        info["num_configs"],
+                        info["budgets"],
+                        mesh=getattr(self.backend, "mesh", None),
+                        axis=getattr(self.backend, "axis", "config"),
+                    )
+                try:
+                    # the dispatch span brackets the tracked-jit boundary
+                    # (ops/fused.py): a first-wave tick here that dwarfs the
+                    # steady state is compile time, and the xla_compile event
+                    # the tracker journals says so explicitly
+                    with obs.span(
+                        "fused_dispatch", iteration=iteration,
+                        n=len(jobs_sorted),
+                    ):
+                        packed = self._fused_fns[shape_key].dispatch(vectors)
+                except Exception as e:  # contain: only THIS wave crashes
+                    self._crash_wave(jobs_sorted, e, "fused dispatch")
+                    crashed = True
+                    continue
+                fetch = (
+                    lambda packed=packed, nc=info["num_configs"]:
+                    _unpack_stages(packed, nc)
+                )
+            dispatched.append((iteration, info, jobs_sorted, fetch))
 
         if not dispatched and not crashed:
             # nothing fused, nothing consumed: let the caller stage-batch
             return None
 
-        for iteration, info, jobs_sorted, packed in dispatched:
+        for iteration, info, jobs_sorted, fetch in dispatched:
             try:
                 # fetch span: the device->host transfer (counted in bytes
-                # by ops/fused._unpack_stages' runtime.transfer_* counters)
+                # by the runners' runtime.transfer_* counters)
                 with obs.span("fused_fetch", iteration=iteration):
-                    stages = _unpack_stages(packed, info["num_configs"])
+                    stages = fetch()
             except Exception as e:
                 self._crash_wave(jobs_sorted, e, "fused fetch")
                 continue

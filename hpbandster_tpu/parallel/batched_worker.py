@@ -76,6 +76,13 @@ class TPUBatchedWorker(Worker):
     ):
         super().__init__(run_id, **worker_kwargs)
         from hpbandster_tpu.parallel.backends import VmapBackend
+        from hpbandster_tpu.utils.compile_cache import (
+            enable_persistent_compile_cache,
+        )
+
+        # this worker compiles a device program per batch shape — warm the
+        # persistent XLA cache before the first one (docs/perf_notes.md)
+        enable_persistent_compile_cache()
 
         if mesh == "auto":
             import jax

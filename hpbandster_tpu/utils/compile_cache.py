@@ -1,0 +1,66 @@
+"""Persistent XLA compile cache — one knob, every process tier.
+
+The fused tiers' first-run cost is dominated by XLA compiles; jax can
+persist compiled executables to disk so the SECOND process on a machine
+pays none of it. ``bench.py`` has enabled this since the fused tiers
+landed, but workers and executors spawned outside the bench (the RPC
+worker pool, ``TPUBatchedWorker``, a user's own ``BatchedExecutor``)
+compiled cold every time. This module is the one shared switch, called
+from every startup path that is about to build device programs.
+
+Knobs (documented in docs/perf_notes.md):
+
+* ``HPB_XLA_CACHE=0`` disables entirely (e.g. hermetic CI);
+* ``HPB_XLA_CACHE_DIR`` overrides the cache directory (default
+  ``~/.cache/hpbandster_tpu_xla``).
+
+Idempotent and exception-free: a jax too old for the config names, an
+unwritable directory, or a disabled env all degrade to "no persistent
+cache" — in-process caches still apply and callers never need a guard.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["enable_persistent_compile_cache"]
+
+#: min compile seconds worth persisting — tiny kernels churn the disk for
+#: nothing; the fused programs this exists for compile in 10s of seconds
+_MIN_COMPILE_TIME_S = 1.0
+
+_enabled_dir: str = ""
+
+
+def enable_persistent_compile_cache(cache_dir: str = "") -> str:
+    """Point jax's persistent compilation cache at a shared directory.
+
+    Returns the directory in use ('' when disabled). Safe to call from
+    any tier, any number of times; only the first effective call touches
+    jax config (re-pointing at a different directory works too, but the
+    common path is a no-op lookup).
+    """
+    global _enabled_dir
+    if os.environ.get("HPB_XLA_CACHE", "") == "0":
+        return ""
+    cache_dir = (
+        cache_dir
+        or os.environ.get("HPB_XLA_CACHE_DIR", "")
+        or os.path.expanduser("~/.cache/hpbandster_tpu_xla")
+    )
+    if _enabled_dir == cache_dir:
+        return _enabled_dir
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", _MIN_COMPILE_TIME_S
+        )
+    # degrade to in-process caches only: older jax spells the flags
+    # differently, and an unwritable HOME must not take down a worker
+    except Exception:  # graftlint: disable=swallowed-exception — cache is an optimization; absence is a valid state
+        return ""
+    _enabled_dir = cache_dir
+    return _enabled_dir

@@ -1,0 +1,23 @@
+"""Known-bad: sharded jit call sites with no donation stance
+(jit-donation). Each flagged line is marked ``# BAD``: in_shardings /
+out_shardings mark a large-buffer program boundary, and the call site
+says nothing about buffer donation — neither donating nor explicitly
+declining."""
+
+import jax
+
+from hpbandster_tpu.obs.runtime import tracked_jit
+
+
+def sharded_no_stance(fn, shard):
+    return jax.jit(fn, in_shardings=(shard,))  # BAD
+
+
+def out_sharded_no_stance(fn, rep):
+    return jax.jit(fn, out_shardings=rep)  # BAD
+
+
+def tracked_sharded_no_stance(fn, shard, rep):
+    return tracked_jit(  # BAD
+        fn, name="sweep", in_shardings=shard, out_shardings=rep
+    )

@@ -1,0 +1,41 @@
+"""Known-good twins for jit-donation: every sharded call site takes an
+explicit donation stance (donate, explicitly decline, or carry the
+decision in a **kwargs splat), and unsharded sites are out of scope."""
+
+import jax
+
+from hpbandster_tpu.obs.runtime import tracked_jit
+
+
+def sharded_donating(fn, shard):
+    # donates: state-threading boundary, outputs alias the donated input
+    return jax.jit(fn, in_shardings=(shard,), donate_argnums=(0,))
+
+
+def sharded_declining(fn, shard):
+    # outputs cannot alias the input (shape mismatch) — considered, declined
+    return jax.jit(fn, in_shardings=(shard,), donate_argnums=())
+
+
+def sharded_by_names(fn, rep):
+    return jax.jit(fn, out_shardings=rep, donate_argnames=("state",))
+
+
+def sharded_splat(fn, shard, extra_kwargs):
+    # the stance lives in the dict; static analysis treats the splat as
+    # an explicit decision site
+    return tracked_jit(fn, in_shardings=(shard,), **extra_kwargs)
+
+
+def unsharded_plain(fn):
+    # no sharding kwargs: not a flagged boundary
+    return jax.jit(fn)
+
+
+def suppressed_with_reason(fn, shard):
+    return jax.jit(fn, in_shardings=(shard,))  # graftlint: disable=jit-donation — prototype bench harness; donation decision deferred to the promoted call site
+
+
+def transform_not_compile(fn, xs):
+    # vmap is a transform, not a compile boundary
+    return jax.vmap(fn)(xs)

@@ -24,6 +24,7 @@ CASES = [
     ("pytest-marker", "test_markers_bad.py", "test_markers_good.py"),
     ("obs-emit-in-jit", "obs_emit_bad.py", "obs_emit_good.py"),
     ("jit-in-loop", "jit_loop_bad.py", "jit_loop_good.py"),
+    ("jit-donation", "donation_bad.py", "donation_good.py"),
 ]
 
 

@@ -28,6 +28,7 @@ RULE_TO_BAD_FIXTURE = {
     "obs-emit-in-jit": "obs_emit_bad.py",
     "obs-reserved-fields": "obs_reserved_bad.py",
     "jit-in-loop": "jit_loop_bad.py",
+    "jit-donation": "donation_bad.py",
 }
 
 

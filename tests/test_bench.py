@@ -19,8 +19,18 @@ import bench  # noqa: E402
 from tests.record_suite import _parse_summary  # noqa: E402
 
 
+@pytest.fixture
+def probe_cache(monkeypatch, tmp_path):
+    """Hermetic probe cache: each test gets its own file (the production
+    default lives in the shared temp dir, which would leak verdicts
+    between tests and between suite runs)."""
+    path = tmp_path / "probe_cache.json"
+    monkeypatch.setenv("HPB_PROBE_CACHE", str(path))
+    return path
+
+
 class TestAcquireBackend:
-    def test_explicit_cpu_env_skips_probe(self, monkeypatch):
+    def test_explicit_cpu_env_skips_probe(self, monkeypatch, probe_cache):
         monkeypatch.setenv("JAX_PLATFORMS", "cpu")
         calls = []
         monkeypatch.setattr(bench, "_probe_backend", lambda t: calls.append(t))
@@ -28,7 +38,7 @@ class TestAcquireBackend:
         assert platform == "cpu" and err is None
         assert calls == []  # no subprocess probe when CPU was asked for
 
-    def test_probe_success_returns_platform(self, monkeypatch):
+    def test_probe_success_returns_platform(self, monkeypatch, probe_cache):
         # setenv (not delenv): _acquire_backend WRITES the env var on
         # fallback, and monkeypatch can only restore what it recorded
         monkeypatch.setenv("JAX_PLATFORMS", "")
@@ -36,7 +46,7 @@ class TestAcquireBackend:
         platform, err = bench._acquire_backend()
         assert platform == "tpu" and err is None
 
-    def test_all_probes_fail_falls_back_to_cpu(self, monkeypatch):
+    def test_all_probes_fail_falls_back_to_cpu(self, monkeypatch, probe_cache):
         monkeypatch.setenv("JAX_PLATFORMS", "")
         attempts = []
 
@@ -55,13 +65,73 @@ class TestAcquireBackend:
         # the fallback must be pinned in the env for the jax import
         assert os.environ["JAX_PLATFORMS"] == "cpu"
 
-    def test_retry_recovers_from_one_transient_failure(self, monkeypatch):
+    def test_retry_recovers_from_one_transient_failure(
+        self, monkeypatch, probe_cache
+    ):
         monkeypatch.setenv("JAX_PLATFORMS", "")
         results = iter([(None, "UNAVAILABLE"), ("tpu", None)])
         monkeypatch.setattr(bench, "_probe_backend", lambda t: next(results))
         monkeypatch.setattr(bench.time, "sleep", lambda s: None)
         platform, err = bench._acquire_backend()
         assert platform == "tpu" and err is None
+
+    def test_cached_failure_skips_reprobe(self, monkeypatch, probe_cache):
+        """Satellite (ISSUE 6): a fresh cached failure short-circuits the
+        whole 2-probe timeout ladder — repeated CPU-fallback runs stop
+        paying 2x120s to rediscover the same dead tunnel."""
+        monkeypatch.setenv("JAX_PLATFORMS", "")
+        monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+        calls = []
+
+        def failing_probe(timeout_s):
+            calls.append(timeout_s)
+            return None, "UNAVAILABLE: tunnel down"
+
+        monkeypatch.setattr(bench, "_probe_backend", failing_probe)
+        platform, err = bench._acquire_backend()
+        assert platform == "cpu" and len(calls) >= 2
+        assert probe_cache.exists()
+
+        # second run inside the TTL: no probe at all, still a loud error
+        monkeypatch.setenv("JAX_PLATFORMS", "")
+        calls.clear()
+        platform, err = bench._acquire_backend()
+        assert platform == "cpu"
+        assert calls == []
+        assert "cached probe failure" in err and "tunnel down" in err
+
+    def test_expired_cache_reprobes(self, monkeypatch, probe_cache):
+        monkeypatch.setenv("JAX_PLATFORMS", "")
+        probe_cache.write_text(json.dumps({
+            "t": bench.time.time() - bench.PROBE_CACHE_TTL_S - 1,
+            "platform": None, "error": "old failure",
+        }))
+        monkeypatch.setattr(bench, "_probe_backend", lambda t: ("tpu", None))
+        platform, err = bench._acquire_backend()
+        assert platform == "tpu" and err is None
+
+    def test_cached_success_never_short_circuits(self, monkeypatch, probe_cache):
+        """Only FAILURES cache: a stale healthy verdict must never skip
+        the probe (the tunnel may have died since)."""
+        monkeypatch.setenv("JAX_PLATFORMS", "")
+        probe_cache.write_text(json.dumps({
+            "t": bench.time.time(), "platform": "tpu", "error": None,
+        }))
+        calls = []
+
+        def probe(t):
+            calls.append(t)
+            return "tpu", None
+
+        monkeypatch.setattr(bench, "_probe_backend", probe)
+        platform, err = bench._acquire_backend()
+        assert platform == "tpu" and len(calls) == 1
+
+    def test_cache_off_env_disables(self, monkeypatch):
+        monkeypatch.setenv("HPB_PROBE_CACHE", "off")
+        assert bench._probe_cache_path() is None
+        assert bench._read_probe_failure() is None
+        bench._write_probe_cache(None, "err")  # must not raise
 
 
 class TestTierIsolation:
@@ -78,6 +148,73 @@ class TestTierIsolation:
     def test_passing_tier_returns_value_and_no_error(self):
         errors = {}
         assert bench._run_tier(errors, "ok", lambda: 42) == 42
+        assert errors == {}
+
+
+class TestBudgetGate:
+    """The enforcement arm of the compile/transfer telemetry (ISSUE 6):
+    a tier that exceeds its declared compile-count or transfer-byte
+    budget must fail LOUDLY (error entry -> degraded artifact), never
+    drift."""
+
+    def test_exceeded_compile_budget_records_loud_error(self, monkeypatch):
+        errors = {}
+        monkeypatch.setitem(bench.COMPILE_BY_TIER, "fused", {
+            "compiles": 99, "compile_s": 1.0, "h2d_bytes": 0, "d2h_bytes": 0,
+        })
+        v = bench._check_tier_budget("fused", errors)
+        assert v is not None and not v["ok"]
+        assert "budget:fused" in errors
+        assert "EXCEEDED" in errors["budget:fused"]
+        monkeypatch.delitem(bench.BUDGET_VERDICTS, "fused", raising=False)
+
+    def test_exceeded_transfer_budget_records_loud_error(self, monkeypatch):
+        errors = {}
+        mb = bench.TIER_BUDGETS["fused"]["max_transfer_mb"]
+        monkeypatch.setitem(bench.COMPILE_BY_TIER, "fused", {
+            "compiles": 1, "compile_s": 0.0,
+            "h2d_bytes": (mb + 1) * 10**6, "d2h_bytes": 0,
+        })
+        v = bench._check_tier_budget("fused", errors)
+        assert not v["ok"] and "budget:fused" in errors
+        monkeypatch.delitem(bench.BUDGET_VERDICTS, "fused", raising=False)
+
+    def test_within_budget_is_ok_and_silent(self, monkeypatch):
+        errors = {}
+        monkeypatch.setitem(bench.COMPILE_BY_TIER, "fused", {
+            "compiles": 1, "compile_s": 1.0,
+            "h2d_bytes": 1000, "d2h_bytes": 1000,
+        })
+        v = bench._check_tier_budget("fused", errors)
+        assert v["ok"] and errors == {}
+        monkeypatch.delitem(bench.BUDGET_VERDICTS, "fused", raising=False)
+
+    def test_unbudgeted_tier_is_ungated(self, monkeypatch):
+        errors = {}
+        monkeypatch.setitem(bench.COMPILE_BY_TIER, "cnn", {
+            "compiles": 500, "compile_s": 0.0,
+            "h2d_bytes": 0, "d2h_bytes": 0,
+        })
+        assert bench._check_tier_budget("cnn", errors) is None
+        assert errors == {}
+
+    def test_run_tier_lands_transfer_deltas(self):
+        """_run_tier's ledger entries carry the byte counters the budget
+        verdicts are computed from."""
+        from hpbandster_tpu.obs.runtime import note_transfer
+
+        errors = {}
+        bench._run_tier(
+            errors, "_budget_probe", lambda: note_transfer("h2d", 1234)
+        )
+        try:
+            entry = bench.COMPILE_BY_TIER["_budget_probe"]
+            assert entry["h2d_bytes"] >= 1234
+            assert set(entry) >= {
+                "compiles", "compile_s", "h2d_bytes", "d2h_bytes",
+            }
+        finally:
+            bench.COMPILE_BY_TIER.pop("_budget_probe", None)
         assert errors == {}
 
 

@@ -200,3 +200,153 @@ class TestPropose:
         b1, _, _ = propose(jax.random.key(5), g, b, vt, cards)
         b2, _, _ = propose(jax.random.key(5), g, b, vt, cards)
         np.testing.assert_array_equal(np.asarray(b1), np.asarray(b2))
+
+
+class TestInTraceRefit:
+    """refit_propose_batch_seeded (ISSUE 6): the KDE refit + proposal as
+    ONE dispatch over raw observation buffers must produce exactly the
+    proposals of the two-step path (explicit masked fit, then the seeded
+    scored proposal kernel) — the refit state just never visits the host."""
+
+    def _observations(self, n_obs=40, cap=64, d=3, seed=2):
+        rng = np.random.default_rng(seed)
+        vecs = rng.uniform(size=(n_obs, d)).astype(np.float32)
+        # losses correlate with distance from 0.2: a real good/bad split
+        losses = np.linalg.norm(vecs - 0.2, axis=1).astype(np.float32)
+        buf_v = np.zeros((cap, d), np.float32)
+        buf_v[:n_obs] = vecs
+        buf_l = np.full(cap, np.inf, np.float32)
+        buf_l[:n_obs] = losses
+        return buf_v, buf_l, n_obs
+
+    def test_one_dispatch_matches_two_step_path(self):
+        from hpbandster_tpu.ops.kde import (
+            fit_kde_pair_masked,
+            propose_batch_seeded_scored,
+            refit_propose_batch_seeded,
+        )
+
+        buf_v, buf_l, n_obs = self._observations()
+        d = buf_v.shape[1]
+        vt = np.zeros(d, np.int32)
+        cards = np.zeros(d, np.int32)
+        n_good, n_bad = 8, 30
+
+        fused_vecs, fused_scores = refit_propose_batch_seeded(
+            np.uint32(9), buf_v, buf_l, np.int32(n_obs), np.int32(n_good),
+            np.int32(n_bad), jnp.asarray(vt), jnp.asarray(cards), 16,
+        )
+        good, bad = fit_kde_pair_masked(
+            jnp.asarray(buf_v), jnp.asarray(buf_l), jnp.asarray(n_obs),
+            jnp.asarray(n_good), jnp.asarray(n_bad), jnp.asarray(cards),
+            1e-3,
+        )
+        ref_vecs, ref_scores = propose_batch_seeded_scored(
+            np.uint32(9), good, bad, jnp.asarray(vt), jnp.asarray(cards), 16,
+        )
+        # same model, same draw; ulp-level drift only — the one-dispatch
+        # program fuses the fit into the scorer, so XLA rounds at
+        # different points than the two-program path materializing the
+        # KDE arrays in between
+        np.testing.assert_allclose(
+            np.asarray(fused_vecs), np.asarray(ref_vecs),
+            rtol=1e-5, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(fused_scores), np.asarray(ref_scores),
+            rtol=1e-4, atol=1e-4,
+        )
+
+    def test_proposals_prefer_good_region(self):
+        from hpbandster_tpu.ops.kde import refit_propose_batch_seeded
+
+        buf_v, buf_l, n_obs = self._observations(n_obs=60, d=2)
+        vt = np.zeros(2, np.int32)
+        cards = np.zeros(2, np.int32)
+        vecs, _ = refit_propose_batch_seeded(
+            np.uint32(3), buf_v, buf_l, np.int32(n_obs), np.int32(10),
+            np.int32(40), jnp.asarray(vt), jnp.asarray(cards), 32,
+        )
+        vecs = np.asarray(vecs)
+        # good cluster = low loss = near 0.2
+        assert (np.linalg.norm(vecs - 0.2, axis=1) < 0.45).mean() > 0.7
+
+    def test_capacity_growth_recompiles_only_on_doubling(self):
+        from hpbandster_tpu.obs.runtime import get_compile_tracker
+        from hpbandster_tpu.ops.kde import refit_propose_batch_seeded
+
+        tracker = get_compile_tracker()
+        tracker.reset()
+        d = 2
+        vt, cards = np.zeros(d, np.int32), np.zeros(d, np.int32)
+        for n_obs in (20, 30, 40):  # same 64-cap buffer: one signature
+            buf_v = np.zeros((64, d), np.float32)
+            buf_v[:n_obs] = np.random.default_rng(n_obs).uniform(
+                size=(n_obs, d)
+            )
+            buf_l = np.full(64, np.inf, np.float32)
+            buf_l[:n_obs] = np.arange(n_obs, dtype=np.float32)
+            refit_propose_batch_seeded(
+                np.uint32(1), buf_v, buf_l, np.int32(n_obs), np.int32(6),
+                np.int32(10), jnp.asarray(vt), jnp.asarray(cards), 8,
+            )
+        led = tracker.snapshot()["functions"]
+        assert led["refit_propose_batch_seeded"]["compiles"] == 1
+
+    def test_bohbkde_in_trace_mode_never_fits_host_models(self):
+        from hpbandster_tpu.core.job import Job
+        from hpbandster_tpu.models.bohb_kde import BOHBKDE
+        from hpbandster_tpu.workloads.toys import branin_space
+
+        cs = branin_space(seed=0)
+        cg = BOHBKDE(
+            configspace=cs, seed=0, in_trace_refit=True,
+            min_points_in_model=5,
+        )
+        rng = np.random.default_rng(0)
+        for i in range(12):
+            cfg = cs.sample_configuration(rng=rng)
+            job = Job((0, 0, i), config=dict(cfg), budget=9.0)
+            job.result = {"loss": float(rng.uniform())}
+            cg.new_result(job)
+        assert cg.largest_budget_with_model() == 9.0
+        assert cg.kde_models == {}  # the fit happened in-trace only
+        out = cg.get_config_batch(9.0, 8)
+        assert len(out) == 8
+        reasons = {info["sample_reason"] for _, info in out}
+        assert "model" in reasons
+        model_infos = [
+            info for _, info in out if info.get("model_based_pick")
+        ]
+        assert all("lg_score" in info for info in model_infos)
+        assert cg.kde_models == {}
+
+    def test_pallas_refit_interpreted_matches_two_step(self):
+        """The Pallas refit+propose twin (interpret mode on CPU) agrees
+        with fit-then-pallas-propose — refit in-trace, scorer fused."""
+        from hpbandster_tpu.ops.kde import fit_kde_pair_masked
+        from hpbandster_tpu.ops.pallas_kde import (
+            pallas_propose_batch_seeded,
+            pallas_refit_propose_batch_seeded,
+        )
+
+        buf_v, buf_l, n_obs = self._observations(n_obs=24, cap=32, d=2)
+        vt = np.zeros(2, np.int32)
+        cards = np.zeros(2, np.int32)
+        fused = pallas_refit_propose_batch_seeded(
+            np.uint32(4), buf_v, buf_l, np.int32(n_obs), np.int32(6),
+            np.int32(18), jnp.asarray(vt), jnp.asarray(cards), 8,
+            interpret=True,
+        )
+        good, bad = fit_kde_pair_masked(
+            jnp.asarray(buf_v), jnp.asarray(buf_l), jnp.asarray(n_obs),
+            jnp.asarray(6), jnp.asarray(18), jnp.asarray(cards), 1e-3,
+        )
+        ref = pallas_propose_batch_seeded(
+            np.uint32(4), good, bad, jnp.asarray(vt), jnp.asarray(cards),
+            8, interpret=True,
+        )
+        # ulp-level drift only (see test_one_dispatch_matches_two_step_path)
+        np.testing.assert_allclose(
+            np.asarray(fused), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
