@@ -65,7 +65,7 @@ HEADLINE_BRACKETS = 27
 TIER_ORDER = (
     "cnn", "cnn_wide", "pallas", "resnet", "transformer", "fused10k",
     "chunked10k", "chunked_compile", "fused", "rpc", "batched", "teacher",
-    "obs_overhead", "runtime_overhead", "report_100k",
+    "obs_overhead", "runtime_overhead", "collector_overhead", "report_100k",
 )
 
 #: per-tier sample size after one warmup run (compile excluded). The driver
@@ -105,7 +105,7 @@ def _read_probe_failure():
         with open(path) as fh:
             entry = json.load(fh)
         if entry.get("error") and (
-            time.time() - float(entry.get("t", 0)) < PROBE_CACHE_TTL_S
+            time.time() - float(entry.get("t", 0)) < PROBE_CACHE_TTL_S  # graftlint: disable=wallclock-duration — the probe cache TTL spans PROCESSES (the stamp was written by an earlier bench run); monotonic clocks do not survive a process boundary
         ):
             return str(entry["error"])
     except (OSError, ValueError, TypeError, KeyError):
@@ -651,7 +651,7 @@ def bench_teacher(seed=0):
     # times_finished are wall-clock job timestamps (reference schema)
     for t, loss in zip(traj["times_finished"], traj["losses"]):
         if loss <= target_err:
-            time_to_target = round(t - wall0, 2)
+            time_to_target = round(t - wall0, 2)  # graftlint: disable=wallclock-duration — times_finished are Job's reference-schema wall timestamps; both ends are wall by API contract
             break
     best_acc = 1.0 - min(traj["losses"]) if traj["losses"] else 0.0
     import jax
@@ -1006,6 +1006,98 @@ def bench_runtime_overhead(repeats=3, inner=100_000, seed=0):
     }
 
 
+def bench_collector_overhead(rounds=40, n_endpoints=3, interval_s=2.0,
+                             seed=0):
+    """Fleet-collector poll cost vs sweep wall under the <2% obs bar.
+
+    Computed, not raced (the obs_overhead method): stand up
+    ``n_endpoints`` REAL health endpoints (RPC servers in-process, the
+    same ``obs_snapshot`` the fleet serves) and measure the median wall
+    cost of one full ``FleetCollector.poll_once()`` round — N socket
+    round-trips + derivation + one series line. The headline
+    ``overhead_pct`` is the steady-state duty cycle, poll_round_s /
+    interval_s: because the collector fires on a fixed interval, its
+    share of ANY sweep's wall reduces to exactly that ratio (the
+    per-sweep product cancels the sweep length by construction, unlike
+    obs_overhead where a measured per-sweep call census makes the sweep
+    load-bearing). One timed sweep rides along as context only —
+    ``rounds_per_sweep`` says how many polls land inside a real sweep
+    at this interval."""
+    import tempfile
+
+    from hpbandster_tpu import obs
+    from hpbandster_tpu.obs.collector import FleetCollector
+    from hpbandster_tpu.optimizers import BOHB
+    from hpbandster_tpu.parallel import BatchedExecutor, VmapBackend
+    from hpbandster_tpu.parallel.rpc import RPCServer
+    from hpbandster_tpu.workloads.toys import branin_from_vector, branin_space
+
+    servers = []
+    endpoints = {}
+    for i in range(n_endpoints):
+        srv = RPCServer("127.0.0.1", 0)
+        obs.HealthEndpoint(
+            component="worker" if i else "dispatcher",
+        ).register(srv)
+        srv.start()
+        servers.append(srv)
+        endpoints[f"ep{i}"] = srv.uri
+    series = tempfile.NamedTemporaryFile(
+        suffix=".jsonl", delete=False
+    ).name
+    collector = FleetCollector(
+        endpoints=endpoints, interval_s=interval_s, series_path=series,
+    )
+    try:
+        collector.poll_once()  # warm (connection setup, first derivation)
+        times = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            collector.poll_once()
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        poll_round_s = times[len(times) // 2]
+    finally:
+        collector.stop()
+        for srv in servers:
+            srv.shutdown()
+        try:
+            os.unlink(series)
+        except OSError:
+            pass
+
+    # one sweep wall, context only (the headline cancels it — docstring)
+    def run_once(s):
+        cs = branin_space(seed=s)
+        executor = BatchedExecutor(
+            VmapBackend(branin_from_vector), cs, parallel_brackets=3
+        )
+        opt = BOHB(
+            configspace=cs, run_id=f"bench-coll{s}", executor=executor,
+            min_budget=1, max_budget=9, eta=3, seed=s,
+        )
+        opt.run(n_iterations=3)
+        opt.shutdown()
+
+    t0 = time.perf_counter()
+    run_once(seed + 32)
+    sweep_s = time.perf_counter() - t0
+
+    duty_cycle_pct = 100.0 * poll_round_s / interval_s
+    return {
+        "n_endpoints": n_endpoints,
+        "poll_rounds_timed": rounds,
+        "poll_round_s": round(poll_round_s, 6),
+        "interval_s": interval_s,
+        "duty_cycle_pct": round(duty_cycle_pct, 4),
+        "sweep_s_context": round(sweep_s, 5),
+        "rounds_per_sweep": round(sweep_s / interval_s, 2),
+        # == duty_cycle_pct by construction; kept as the cross-tier
+        # headline key every obs tier's <2% bar is read from
+        "overhead_pct": round(duty_cycle_pct, 4),
+    }
+
+
 def bench_report_100k(n_events=100_000, seed=0):
     """Report-CLI throughput over a synthetic ``n_events``-line journal.
 
@@ -1323,6 +1415,9 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
         runtime_overhead = emit("runtime_overhead", _run_tier(
             errors, "runtime_overhead", bench_runtime_overhead,
             inner=5_000))
+        collector_overhead = emit("collector_overhead", _run_tier(
+            errors, "collector_overhead", bench_collector_overhead,
+            rounds=10))
         report_100k = emit("report_100k", _run_tier(
             errors, "report_100k", bench_report_100k, n_events=5_000))
     else:
@@ -1480,6 +1575,15 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
                            bench_runtime_overhead))
             if selected("runtime_overhead") else dict(NOT_SELECTED)
         )
+        # backend-independent like obs_overhead: socket polling + series
+        # writes are pure host work, and the <2% fleet-observatory claim
+        # (docs/observability.md) must regenerate on the fallback path too
+        collector_overhead = (
+            emit("collector_overhead",
+                 _run_tier(errors, "collector_overhead",
+                           bench_collector_overhead))
+            if selected("collector_overhead") else dict(NOT_SELECTED)
+        )
         # backend-independent like obs_overhead: journal synthesis + the
         # report pipeline are pure host work, so the throughput (and the
         # byte-identical determinism check) measures on the fallback too
@@ -1574,6 +1678,7 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
             "chunked10k_at_scale_36_brackets_1_729": chunked10k,
             "obs_overhead_no_sink": obs_overhead,
             "runtime_overhead_tracked_jit": runtime_overhead,
+            "collector_overhead_fleet_poll": collector_overhead,
             "report_100k_events": report_100k,
             "compile_by_tier": dict(sorted(COMPILE_BY_TIER.items())),
             # the budget gate's record: what each tier declared vs paid.
@@ -1829,6 +1934,21 @@ def write_baseline(result, path="BASELINE.md", source=None):
     ))
     lines.append("")
     lines.append(render(
+        d.get("collector_overhead_fleet_poll"),
+        lambda x: (
+            "Fleet-collector overhead (%d endpoints over real sockets): "
+            "%.3f%% steady-state duty cycle — one poll round %.2f ms at "
+            "a %.0f s interval, ~%.0f rounds per warm sweep "
+            "(docs/observability.md 'Fleet observatory'; acceptance bar "
+            "< 2%%)."
+            % (x["n_endpoints"], x["overhead_pct"],
+               1e3 * x["poll_round_s"], x["interval_s"],
+               x.get("rounds_per_sweep") or 0)
+        ),
+        fallback="Fleet-collector overhead: not measured in this artifact.",
+    ))
+    lines.append("")
+    lines.append(render(
         d.get("report_100k_events"),
         lambda x: (
             "Run-report pipeline over a synthetic %d-event journal: "
@@ -1891,7 +2011,8 @@ def compact_line(result, detail_file):
               "teacher_workload_budget_epochs", "pallas_scorer_vs_xla",
               "chunked_compile_static_vs_dynamic",
               "chunked10k_at_scale_36_brackets_1_729",
-              "obs_overhead_no_sink", "runtime_overhead_tracked_jit"):
+              "obs_overhead_no_sink", "runtime_overhead_tracked_jit",
+              "collector_overhead_fleet_poll"):
         tiers[k] = d.get(k)
     out["tiers_measured"] = sorted(
         k for k, v in tiers.items()

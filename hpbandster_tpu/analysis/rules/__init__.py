@@ -15,4 +15,5 @@ from hpbandster_tpu.analysis.rules import (  # noqa: F401
     obs_emit,
     obs_reserved,
     prng,
+    wallclock,
 )

@@ -1,0 +1,108 @@
+"""wallclock-duration — durations computed from the wall clock.
+
+``time.time()`` answers *when*; ``time.monotonic()`` answers *how long*.
+Subtracting wall-clock readings measures NTP slews, DST steps, and VM
+clock corrections along with the thing being timed — a watchdog built on
+``time.time() - started`` fires early (or never) the day the host's
+clock steps, which in this fleet means a healthy worker self-shutting
+mid-rung or a checkpoint cadence silently stalling. The repo's contract
+(docs/observability.md, ``core.job.Job``'s wall/mono twin stamps) is
+explicit: wall-clock values are *timestamps* for humans and cross-host
+journal ordering, monotonic values are for arithmetic.
+
+Flagged — a ``-`` (subtraction) expression where either operand is
+
+* a direct ``time.time()`` call (``time.time() - self._t0``,
+  ``now - time.time()``), or
+* a local name bound to ``time.time()`` earlier in the same function
+  (``t0 = time.time(); ...; dt = end - t0``).
+
+Not flagged: storing/emitting wall timestamps verbatim (``{"t_wall":
+time.time()}``), monotonic arithmetic, and cross-*process* wall math on
+journaled timestamps — monotonic clocks do not compare across hosts, so
+those sites stay legal but deserve a suppression explaining exactly
+that.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from hpbandster_tpu.analysis.core import Finding, Rule, SourceModule, register
+from hpbandster_tpu.analysis.rules._util import import_map_for, iter_functions
+
+_WALL_CALLS = {"time.time", "datetime.datetime.now", "datetime.datetime.utcnow"}
+
+
+def _is_wall_call(node: ast.AST, imports) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and not node.args and not node.keywords
+        and (imports.resolve(node.func) or "") in _WALL_CALLS
+    )
+
+
+def _wall_names(fn: ast.AST, imports) -> Set[str]:
+    """Local names assigned directly from a wall-clock call anywhere in
+    ``fn`` (flow-insensitive on purpose: a name that EVER holds a wall
+    timestamp should never sit in duration arithmetic)."""
+    names: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and _is_wall_call(node.value, imports):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    names.add(target.id)
+    return names
+
+
+@register
+class WallclockDurationRule(Rule):
+    name = "wallclock-duration"
+    description = (
+        "duration computed by subtracting wall-clock time.time() readings "
+        "— clock steps corrupt the interval; use time.monotonic()"
+    )
+
+    def check(self, module: SourceModule) -> List[Finding]:
+        if "time" not in module.text:
+            return []
+        imports = import_map_for(module)
+        findings: List[Finding] = []
+        seen: Set[int] = set()
+
+        def scan(scope: ast.AST, wall_names: Set[str]) -> None:
+            def is_wall(operand: ast.AST) -> bool:
+                if _is_wall_call(operand, imports):
+                    return True
+                return (
+                    isinstance(operand, ast.Name) and operand.id in wall_names
+                )
+
+            for node in ast.walk(scope):
+                if not isinstance(node, ast.BinOp) or not isinstance(
+                    node.op, ast.Sub
+                ):
+                    continue
+                if id(node) in seen:
+                    continue
+                if is_wall(node.left) or is_wall(node.right):
+                    seen.add(id(node))
+                    findings.append(
+                        self.finding(
+                            module, node,
+                            "wall-clock subtraction measures clock steps, "
+                            "not elapsed time: take the operands from "
+                            "time.monotonic() (keep time.time() only as a "
+                            "verbatim timestamp; suppress with "
+                            "justification for cross-process wall math)",
+                        )
+                    )
+
+        for fn in iter_functions(module.tree):
+            scan(fn, _wall_names(fn, imports))
+        # module level: direct calls only (module-scope assignments of
+        # wall stamps subtracted later are overwhelmingly cross-run
+        # timestamps, not durations)
+        scan(module.tree, set())
+        return findings

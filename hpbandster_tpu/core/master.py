@@ -53,6 +53,7 @@ class Master:
         shutdown_workers: bool = True,
         checkpoint_path: Optional[str] = None,
         checkpoint_interval: float = 30.0,
+        collector: Any = None,
     ):
         self.run_id = run_id
         self.config_generator = config_generator
@@ -73,10 +74,11 @@ class Master:
 
         # optional mid-run state checkpointing (capability the reference
         # lacks — see core/checkpoint.py); auto-saves at most every
-        # checkpoint_interval seconds from job_callback
+        # checkpoint_interval seconds from job_callback. Monotonic clock:
+        # an NTP step must not suppress (or force) a checkpoint
         self.checkpoint_path = checkpoint_path
         self.checkpoint_interval = float(checkpoint_interval)
-        self._last_checkpoint = 0.0
+        self._last_checkpoint_mono = 0.0
 
         # re-entrant: batched executors fire job_callback synchronously from
         # inside flush(), which runs under this same condition
@@ -114,6 +116,67 @@ class Master:
         self.parallel_brackets: float = getattr(
             self.executor, "preferred_parallel_brackets", float("inf")
         )
+
+        # fleet observatory (obs/collector.py, docs/observability.md
+        # "Fleet observatory"): collector=True (defaults) or a dict of
+        # FleetCollector kwargs (interval_s, series_path, ...) gives the
+        # master its own health endpoint server AND a collector polling
+        # master + dispatcher + every discovered worker into the derived
+        # fleet gauges. Purely additive: no collector, no new threads.
+        self.health_server = None
+        self.fleet_collector = None
+        if collector:
+            self._start_collector(
+                collector if isinstance(collector, dict) else {}
+            )
+
+    # ------------------------------------------------------ fleet observatory
+    def _start_collector(self, options: Dict[str, Any]) -> None:
+        """Serve this master's own ``obs_snapshot`` endpoint and start a
+        :class:`~hpbandster_tpu.obs.collector.FleetCollector` polling the
+        whole fleet — master + dispatcher + every discovered worker (the
+        endpoint listing is re-read per round, so an elastic pool is
+        tracked as it churns)."""
+        from hpbandster_tpu.obs.collector import FleetCollector
+        from hpbandster_tpu.parallel.rpc import RPCServer
+
+        server = RPCServer(getattr(self.executor, "host", None) or "127.0.0.1", 0)
+        obs.HealthEndpoint(
+            component="master",
+            identity=obs.process_identity(run_id=self.run_id),
+            in_flight=self._health_in_flight,
+        ).register(server)
+        server.start()
+        self.health_server = server
+        self.fleet_collector = FleetCollector(
+            endpoints=self._fleet_endpoints, **options
+        ).start()
+
+    def _health_in_flight(self) -> Dict[str, Any]:
+        with self.thread_cond:
+            return {
+                "running_jobs": self.num_running_jobs,
+                "iterations": len(self.iterations),
+                "active_iterations": len(self.active_iterations()),
+            }
+
+    def _fleet_endpoints(self) -> Dict[str, str]:
+        """The collector's per-round endpoint listing: every fleet process
+        that answers ``obs_snapshot`` right now."""
+        eps: Dict[str, str] = {}
+        if self.health_server is not None:
+            eps["master"] = self.health_server.uri
+        server = getattr(self.executor, "_server", None)
+        uri = getattr(server, "uri", None)
+        if uri:
+            eps["dispatcher"] = uri
+        workers = getattr(self.executor, "workers", None)
+        if isinstance(workers, dict):
+            for name, w in list(workers.items()):
+                w_uri = getattr(w, "uri", None)
+                if w_uri:
+                    eps[name] = w_uri
+        return eps
 
     # ----------------------------------------------------------------- hooks
     def get_next_iteration(
@@ -185,7 +248,8 @@ class Master:
                 self.thread_cond.notify_all()
             if (
                 self.checkpoint_path is not None
-                and time.time() - self._last_checkpoint > self.checkpoint_interval
+                and time.monotonic() - self._last_checkpoint_mono
+                > self.checkpoint_interval
             ):
                 self.save_checkpoint(self.checkpoint_path)
 
@@ -329,6 +393,12 @@ class Master:
 
     def shutdown(self, shutdown_workers: bool = False) -> None:
         self.logger.debug("master shutdown (workers=%s)", shutdown_workers)
+        if self.fleet_collector is not None:
+            self.fleet_collector.stop()
+            self.fleet_collector = None
+        if self.health_server is not None:
+            self.health_server.shutdown()
+            self.health_server = None
         self.executor.shutdown(shutdown_workers)
 
     # ------------------------------------------------------------ checkpoint
@@ -338,7 +408,7 @@ class Master:
 
         t0 = time.monotonic()
         save_checkpoint(self, path)
-        self._last_checkpoint = time.time()
+        self._last_checkpoint_mono = time.monotonic()
         obs.emit(
             obs.CHECKPOINT_WRITTEN,
             path=path, duration_s=round(time.monotonic() - t0, 6),

@@ -78,7 +78,9 @@ class Worker:
         self._server: Optional[RPCServer] = None
         self._busy_lock = threading.Lock()
         self._shutdown_event = threading.Event()
-        self._last_active = time.time()
+        # monotonic: the idle watchdog computes durations from this, and
+        # a host clock step must not self-shutdown a healthy worker
+        self._last_active = time.monotonic()
         self._timeout_thread: Optional[threading.Thread] = None
 
         # ---- observability: worker-local journal / ring / health -------
@@ -165,7 +167,7 @@ class Worker:
 
     def _timeout_watchdog(self) -> None:
         while not self._shutdown_event.wait(min(self.timeout, 1.0)):
-            idle = time.time() - self._last_active
+            idle = time.monotonic() - self._last_active
             if not self._busy_lock.locked() and idle > self.timeout:
                 self.logger.info("worker idle for %.1fs; self-shutdown", idle)
                 self.shutdown()
@@ -241,7 +243,7 @@ class Worker:
     ) -> bool:
         if not self._busy_lock.acquire(blocking=False):
             raise RuntimeError("worker is busy")
-        self._last_active = time.time()
+        self._last_active = time.monotonic()
         self._current_job = tuple(id)
         # threads do not inherit contextvars: capture the trace the RPC
         # handler extracted from the _obs envelope and hand it to the
@@ -283,7 +285,7 @@ class Worker:
                 self.logger.warning("compute crashed:\n%s", exception)
             finally:
                 compute_s = time.monotonic() - t0
-                self._last_active = time.time()
+                self._last_active = time.monotonic()
                 # guarded: once the busy lock is released a NEW job may
                 # already own the marker while this thread is still in
                 # delivery backoff — never clobber it

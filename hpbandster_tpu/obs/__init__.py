@@ -32,6 +32,15 @@ emit into (see docs/observability.md):
   exporter: strict text exposition rendering of any registry snapshot,
   a round-trip parser, the ``metrics_text`` health-RPC mount, and the
   ``python -m hpbandster_tpu.obs export`` HTTP bridge;
+* :mod:`~hpbandster_tpu.obs.collector` — the fleet observatory:
+  :class:`FleetCollector` polls every ``obs_snapshot`` endpoint into a
+  rotating series file + derived fleet gauges (device balance, worker
+  churn, queue trend, compile rate) feeding the ``fleet_imbalance`` /
+  ``worker_churn`` anomaly rules and the ``obs top`` dashboard;
+* :mod:`~hpbandster_tpu.obs.profile` — on-demand deep profiling:
+  :class:`ProfileSession` behind the ``start_profile``/``stop_profile``
+  health RPCs, plus :func:`roofline_report` over the AOT compile
+  ledger's cost analysis (FLOPs/bytes per bucketed program);
 * ``python -m hpbandster_tpu.obs summarize <journal> [<journal> ...]`` —
   per-stage latency percentiles, worker utilization, failure tallies, and
   merged cross-host per-trace timelines; ``report`` renders the
@@ -66,6 +75,12 @@ from hpbandster_tpu.obs.anomaly import (  # noqa: F401
     AnomalyRules,
     scan_records,
 )
+from hpbandster_tpu.obs.collector import (  # noqa: F401
+    FleetCollector,
+    derive_fleet,
+    format_fleet_table,
+    read_series,
+)
 from hpbandster_tpu.obs.audit import (  # noqa: F401
     AUDIT_EVENTS,
     config_lineage,
@@ -79,6 +94,7 @@ from hpbandster_tpu.obs.events import (  # noqa: F401
     CHECKPOINT_WRITTEN,
     CONFIG_SAMPLED,
     EVENT_TYPES,
+    FLEET_SAMPLE,
     JOB_FAILED,
     JOB_FINISHED,
     JOB_STARTED,
@@ -121,6 +137,13 @@ from hpbandster_tpu.obs.metrics import (  # noqa: F401
     MetricsRegistry,
     get_metrics,
 )
+from hpbandster_tpu.obs.profile import (  # noqa: F401
+    ProfileSession,
+    device_peaks,
+    format_roofline,
+    get_profile_session,
+    roofline_report,
+)
 from hpbandster_tpu.obs.runtime import (  # noqa: F401
     CompileTracker,
     DeviceSampler,
@@ -153,6 +176,9 @@ __all__ = [
     "CompileTracker", "DeviceSampler", "get_compile_tracker",
     "note_transfer", "runtime_snapshot", "start_device_sampler",
     "tracked_jit",
+    "FleetCollector", "derive_fleet", "format_fleet_table", "read_series",
+    "ProfileSession", "get_profile_session", "device_peaks",
+    "roofline_report", "format_roofline",
     "render_snapshot", "render_registry", "parse_prometheus_text",
     "configure", "set_enabled", "enabled",
     "EVENT_TYPES", "JOB_SUBMITTED", "JOB_STARTED", "JOB_FINISHED",
@@ -160,6 +186,7 @@ __all__ = [
     "BRACKET_PROMOTION", "KDE_REFIT", "RPC_RETRY", "RESULT_DELIVERED",
     "CHECKPOINT_WRITTEN", "UNKNOWN_RESULT",
     "CONFIG_SAMPLED", "PROMOTION_DECISION", "ALERT", "XLA_COMPILE",
+    "FLEET_SAMPLE",
 ]
 
 

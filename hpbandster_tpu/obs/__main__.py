@@ -11,9 +11,16 @@
   byte-identical.
 * ``watch <journal> [--interval S] [--ticks N]`` — tail a live journal,
   one status line per tick; runs until ^C unless ``--ticks`` bounds it.
-  ``watch --snapshot <uri>`` polls a live process's ``obs_snapshot``
-  health RPC instead — latency quantiles, compile counts, and device
-  memory with no journal on disk.
+  ``watch --snapshot <uri> [--snapshot <uri> ...]`` polls live
+  processes' ``obs_snapshot`` health RPCs instead — latency quantiles,
+  compile counts, and device memory with no journal on disk; several
+  URIs merge one row per endpoint per tick (collector poll/staleness
+  under the hood).
+* ``top --snapshot <uri> [--snapshot <uri> ...]`` (or ``top --series
+  <file>``) — the live fleet dashboard (``obs/collector.py``): a
+  refreshing table of endpoints, in-flight work, device balance, alerts
+  and top recompilers, plus the derived fleet gauges. ``q``+Enter or
+  ^C quits; ``--ticks``/``--no-clear`` give the scripted/test mode.
 * ``export --port N [--snapshot <uri>] [--host H]`` — standalone
   Prometheus exporter (``obs/export.py``): serves ``GET /metrics`` in
   the strict text exposition format, rendering this process's registry
@@ -32,7 +39,8 @@ import argparse
 import json
 import os
 import sys
-from typing import List, Optional
+import time
+from typing import Any, List, Optional, Tuple
 
 from hpbandster_tpu.obs.journal import journal_paths
 from hpbandster_tpu.obs.report import build_report, format_report
@@ -134,6 +142,96 @@ def run_export(
     return 0
 
 
+def _top_wait_or_quit(interval: float) -> bool:
+    """Sleep one refresh interval; True = keep running. Keybindings:
+    ``q`` (+Enter) or ^C quits — stdin is only consulted when it is a
+    real TTY, so piped/scripted runs never block on it."""
+    try:
+        if sys.stdin is not None and sys.stdin.isatty():
+            import select
+
+            ready, _, _ = select.select([sys.stdin], [], [], interval)
+            if ready:
+                line = sys.stdin.readline()
+                if line.strip().lower().startswith("q"):
+                    return False
+        else:
+            time.sleep(interval)
+    except KeyboardInterrupt:  # graftlint: disable=swallowed-exception — ^C is the intended way to leave top
+        return False
+    except (OSError, ValueError):  # closed/odd stdin: plain sleep instead
+        time.sleep(interval)
+    return True
+
+
+def run_top(
+    uris: Optional[List[str]],
+    series: Optional[str] = None,
+    interval: float = 2.0,
+    ticks: Optional[int] = None,
+    clear: bool = True,
+    stream: Optional[Any] = None,
+) -> int:
+    """The ``top`` subcommand body (separated so tests drive it): a
+    refreshing fleet table from live endpoint polling (``--snapshot``,
+    repeatable) or from the newest sample of a collector series file
+    (``--series``)."""
+    from hpbandster_tpu.obs.collector import (
+        format_fleet_table,
+        read_series_tail,
+    )
+    from hpbandster_tpu.obs.summarize import make_viewer_collector
+
+    out = stream if stream is not None else sys.stdout
+    if bool(uris) == bool(series):
+        print(
+            "error: top needs --snapshot URI(s) or --series PATH (not both)",
+            file=sys.stderr,
+        )
+        return 2
+    collector = None
+    if uris:
+        try:
+            collector = make_viewer_collector(uris, interval)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    tick = 0
+    sample = None
+    series_stat: Optional[Tuple[int, int]] = None
+    while True:
+        if collector is not None:
+            sample = collector.poll_once()
+        else:
+            if not os.path.exists(series):
+                print(f"error: series file {series!r} does not exist",
+                      file=sys.stderr)
+                return 2
+            st = os.stat(series)
+            stat_now = (st.st_mtime_ns, st.st_size)
+            # re-read only when the live file actually changed; even
+            # then only its tail — a tick renders one frame, not the
+            # fleet's whole history
+            if stat_now != series_stat:
+                series_stat = stat_now
+                sample = read_series_tail(series)
+        if clear:
+            print("\x1b[2J\x1b[H", end="", file=out)
+        stamp = time.strftime("%H:%M:%S")
+        source = "live" if collector is not None else series
+        print(f"hpbandster fleet top — {stamp} ({source})  [q quits]",
+              file=out)
+        if sample is not None:
+            print(format_fleet_table(sample), file=out, flush=True)
+        else:
+            print("(no fleet samples yet)", file=out, flush=True)
+        tick += 1
+        if ticks is not None and tick >= ticks:
+            return 0
+        if not _top_wait_or_quit(interval):
+            return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m hpbandster_tpu.obs",
@@ -175,9 +273,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="path to a (possibly future) journal",
     )
     p_watch.add_argument(
-        "--snapshot", metavar="URI", default=None,
+        "--snapshot", metavar="URI", action="append", default=None,
         help="poll obs_snapshot on this RPC endpoint (host:port) instead "
-        "of tailing a journal — latency quantiles without a journal",
+        "of tailing a journal — latency quantiles without a journal; "
+        "repeat for several endpoints (one merged row each per tick)",
     )
     p_watch.add_argument(
         "--interval", type=float, default=2.0, help="seconds between ticks"
@@ -185,6 +284,33 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_watch.add_argument(
         "--ticks", type=int, default=None,
         help="stop after N ticks (default: run until ^C)",
+    )
+    p_top = sub.add_parser(
+        "top",
+        help="live fleet dashboard: refreshing table of endpoints, device "
+        "balance, alerts, top recompilers (see docs/observability.md "
+        "'Fleet observatory')",
+    )
+    p_top.add_argument(
+        "--snapshot", metavar="URI", action="append", default=None,
+        help="poll obs_snapshot on this endpoint (host:port); repeat for "
+        "the whole fleet (master + dispatcher + workers)",
+    )
+    p_top.add_argument(
+        "--series", metavar="PATH", default=None,
+        help="render the newest sample of a collector series file instead "
+        "of polling live endpoints",
+    )
+    p_top.add_argument(
+        "--interval", type=float, default=2.0, help="seconds between refreshes"
+    )
+    p_top.add_argument(
+        "--ticks", type=int, default=None,
+        help="stop after N refreshes (default: run until q/^C)",
+    )
+    p_top.add_argument(
+        "--no-clear", action="store_true", dest="no_clear",
+        help="append frames instead of clearing the screen (pipelines/tests)",
     )
     p_exp = sub.add_parser(
         "export",
@@ -210,6 +336,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="print one exposition to stdout and exit (no HTTP server)",
     )
     args = parser.parse_args(argv)
+
+    if args.command == "top":
+        return run_top(
+            uris=args.snapshot, series=args.series, interval=args.interval,
+            ticks=args.ticks, clear=not args.no_clear,
+        )
 
     if args.command == "export":
         return run_export(

@@ -26,6 +26,16 @@ docs/observability.md "Alert rules"):
   last ``kde_refit`` while a model exists: the optimizer has silently
   degraded to random search (e.g. every new result lands on a budget
   whose fit keeps failing the min-points gate).
+* **fleet_imbalance** — the fleet collector's ``fleet_sample`` records
+  report ``device_mem_skew`` at or above ``imbalance_skew`` for
+  ``imbalance_consecutive`` consecutive samples: one device is carrying
+  the memory the mesh sharding was supposed to spread, and a single hot
+  sample is a transient while a sustained streak is a placement bug.
+* **worker_churn** — a ``fleet_sample``'s ``worker_churn_per_min``
+  (worker drops + endpoint losses, windowed by the collector) at or
+  above ``churn_per_min``: distinct from ``worker_flapping`` (ONE host
+  cycling), this is the fleet-wide rate that says rungs are being
+  rebalanced faster than they can drain.
 * **recompile_storm** — one function's ``xla_compile`` events
   (``obs/runtime.py``'s ``tracked_jit``) arriving
   ``recompile_threshold`` times within ``recompile_window_s``. A compile
@@ -104,6 +114,19 @@ class AnomalyRules:
     recompile_threshold: int = 6
     recompile_window_s: float = 600.0
 
+    #: fleet_imbalance: device_mem_skew >= this for `imbalance_consecutive`
+    #: consecutive fleet_sample records (consecutive=0 disables). The
+    #: default skew clears a ragged-but-working fleet (last bracket chunk
+    #: pads unevenly) while a device holding ~everything fires
+    imbalance_skew: float = 0.6
+    imbalance_consecutive: int = 3
+
+    #: worker_churn: fleet_sample worker_churn_per_min >= this (0
+    #: disables) — drops + endpoint losses per minute, fleet-wide over
+    #: the collector's fixed churn window (default 1.0 = ten churn
+    #: events inside a 10-minute window)
+    churn_per_min: float = 1.0
+
     #: per-(rule, subject) re-alert suppression
     cooldown_s: float = 60.0
 
@@ -147,6 +170,7 @@ class AnomalyDetector:
         self._results_since_refit = 0
         self._refit_seen = False
         self._compile_times: Dict[str, Deque[float]] = {}
+        self._imbalance_streak = 0
         self._last_alert: Dict[Tuple[str, str], float] = {}
 
     # ------------------------------------------------------------- plumbing
@@ -342,6 +366,49 @@ class AnomalyDetector:
                     compiles=len(recent), window_s=r.recompile_window_s,
                     compile_s=rec.get("compile_s"),
                     signature=rec.get("signature"),
+                )
+                if a:
+                    fired.append(a)
+
+        # --- fleet rules: the collector's derived gauges. Live samples
+        # arrive flattened on the bus event; series-file lines nest them
+        # under "fleet" — both shapes are read, which is what keeps
+        # scan_records over a series file in parity with the live sink.
+        if name == E.FLEET_SAMPLE:
+            fleet = rec.get("fleet")
+            if not isinstance(fleet, dict):
+                fleet = rec
+            skew = fleet.get("device_mem_skew")
+            if r.imbalance_consecutive > 0:
+                if (
+                    isinstance(skew, (int, float)) and math.isfinite(skew)
+                    and skew >= r.imbalance_skew
+                ):
+                    self._imbalance_streak += 1
+                    if self._imbalance_streak >= r.imbalance_consecutive:
+                        a = self._fire(
+                            rec, "fleet_imbalance", "devices",
+                            skew=round(float(skew), 4),
+                            threshold=r.imbalance_skew,
+                            consecutive=self._imbalance_streak,
+                        )
+                        if a:
+                            fired.append(a)
+                            self._imbalance_streak = 0
+                else:
+                    self._imbalance_streak = 0
+            churn = fleet.get("worker_churn_per_min")
+            if (
+                r.churn_per_min > 0
+                and isinstance(churn, (int, float)) and math.isfinite(churn)
+                and churn >= r.churn_per_min
+            ):
+                a = self._fire(
+                    rec, "worker_churn", "fleet",
+                    churn_per_min=round(float(churn), 4),
+                    threshold=r.churn_per_min,
+                    lost_endpoints=fleet.get("lost"),
+                    churn_events=fleet.get("churn_events"),
                 )
                 if a:
                     fired.append(a)

@@ -1,0 +1,765 @@
+"""Fleet observatory: poll every health endpoint into a time series.
+
+PRs 2–5 made each fleet process individually legible — ``obs_snapshot``
+answers *what is this process doing right now*, the Prometheus exporter
+answers *what do its counters read this instant*. Nothing answered the
+fleet-level questions the scale-out arc needs: is device memory balanced
+across the mesh, are workers churning, is the queue draining, is XLA
+compiling more than it should — *over time*.
+
+:class:`FleetCollector` is that aggregation tier. It polls a set of
+``obs_snapshot`` endpoints (master, dispatcher, every worker) on an
+interval, folds each round into
+
+* an on-disk **series file** — one strict-JSON line per poll round
+  (``fleet_sample`` schema), written through the same rotating
+  :class:`~hpbandster_tpu.obs.journal.JsonlJournal` machinery as run
+  journals, so disk stays bounded however long the fleet runs;
+* a bounded **in-memory window** (the newest ``window`` samples) that
+  the ``obs top`` dashboard and trend math read;
+* **derived fleet gauges** republished through the shared metrics
+  registry (``fleet.*``), so the Prometheus exporter and the anomaly
+  detector's ``fleet_imbalance`` / ``worker_churn`` rules see them with
+  zero extra wiring:
+
+  - ``fleet.endpoints`` / ``fleet.endpoints_ok`` / ``fleet.endpoints_stale``
+  - ``fleet.workers_alive`` / ``fleet.queue_depth`` / ``fleet.jobs_in_flight``
+  - ``fleet.device_mem_utilization`` — bytes in use / limit, fleet-wide
+  - ``fleet.device_mem_skew`` — (max - min)/max over per-device busy
+    bytes: the balance number the mesh-sharding arc reads
+  - ``fleet.worker_churn_per_min`` — worker drops + endpoint losses
+  - ``fleet.queue_depth_trend_per_min`` — signed queue drain/growth rate
+  - ``fleet.compile_rate_per_min`` — fresh XLA compiles across the fleet
+
+Failure containment is the design center: every endpoint is polled with
+its own socket timeout, so a dead or *hung* peer costs one bounded
+timeout, never a stalled loop; the failed endpoint's row records the
+gap (``ok=False`` + ``stale_s``) and its disappearance counts into the
+churn rate. The collector never raises out of its poll loop.
+
+One poll round also emits one ``fleet_sample`` event onto the bus, so a
+configured journal retains the fleet story and the streaming anomaly
+detector sees the derived gauges the moment they are computed —
+:func:`~hpbandster_tpu.obs.anomaly.scan_records` over a series file
+replays the same rules offline (tested parity).
+
+Like ``health.py``, this module is transport-lazy: ``parallel/rpc.py``
+imports only inside the default fetcher, so the obs substrate stays
+stdlib-only at import.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+from hpbandster_tpu.obs import events as E
+from hpbandster_tpu.obs.journal import JsonlJournal
+from hpbandster_tpu.obs.metrics import MetricsRegistry, get_metrics
+
+__all__ = [
+    "EndpointState",
+    "FleetCollector",
+    "derive_fleet",
+    "format_fleet_table",
+    "read_series",
+    "read_series_tail",
+]
+
+logger = E.logger
+
+
+def _rpc_fetch(uri: str, timeout: float) -> Dict[str, Any]:
+    """Default snapshot fetcher: one ``obs_snapshot`` RPC with its own
+    socket timeout (connect and read both bounded — a hung peer costs
+    ``timeout`` seconds, not a stalled collector)."""
+    # lazy: the obs substrate never pulls in the RPC transport at import
+    from hpbandster_tpu.parallel.rpc import RPCProxy
+
+    snap = RPCProxy(uri, timeout=timeout).call("obs_snapshot")
+    if not isinstance(snap, dict):
+        raise ValueError(f"obs_snapshot from {uri} returned {type(snap).__name__}")
+    return snap
+
+
+class EndpointState:
+    """Per-endpoint staleness bookkeeping (one instance per known URI)."""
+
+    __slots__ = (
+        "name", "uri", "ok", "ever_ok", "last_ok_mono", "last_error",
+        "consecutive_failures", "last_snapshot", "last_counters",
+    )
+
+    def __init__(self, name: str, uri: str):
+        self.name = name
+        self.uri = uri
+        self.ok = False
+        self.ever_ok = False
+        self.last_ok_mono: Optional[float] = None
+        self.last_error: Optional[str] = None
+        self.consecutive_failures = 0
+        self.last_snapshot: Optional[Dict[str, Any]] = None
+        #: counters cut at the last successful poll (rate math)
+        self.last_counters: Dict[str, float] = {}
+
+    def stale_s(self, now_mono: float) -> Optional[float]:
+        """Seconds since the last successful poll; None if never polled
+        successfully (a peer that has not come up yet is not *stale*)."""
+        if self.last_ok_mono is None:
+            return None
+        return max(now_mono - self.last_ok_mono, 0.0)
+
+
+def _num(x: Any) -> Optional[float]:
+    if isinstance(x, bool) or not isinstance(x, (int, float)):
+        return None
+    return float(x) if math.isfinite(x) else None
+
+
+def _endpoint_row(snap: Dict[str, Any]) -> Dict[str, Any]:
+    """Distill one ``obs_snapshot`` into the per-endpoint series row: the
+    handful of fields fleet aggregation and ``top`` actually read."""
+    metrics = snap.get("metrics") or {}
+    gauges = metrics.get("gauges") or {}
+    counters = metrics.get("counters") or {}
+    runtime = snap.get("runtime") or {}
+    compile_led = runtime.get("compile") or {}
+    devices = (runtime.get("devices") or {}).get("devices") or {}
+    dev_rows = {}
+    for i, d in devices.items():
+        if isinstance(d, dict):
+            dev_rows[str(i)] = {
+                k: d.get(k)
+                for k in ("bytes_in_use", "bytes_limit", "live_bytes")
+                if d.get(k) is not None
+            }
+    alerts = snap.get("alerts") or {}
+    return {
+        "component": snap.get("component"),
+        "uptime_s": _num(snap.get("uptime_s")),
+        "in_flight": snap.get("in_flight"),
+        "workers_alive": _num(gauges.get("dispatcher.workers_alive")),
+        "queue_depth": _num(gauges.get("dispatcher.queue_depth")),
+        "jobs_in_flight": _num(gauges.get("dispatcher.jobs_in_flight")),
+        "workers_dropped": _num(counters.get("dispatcher.workers_dropped")),
+        "compiles": _num(counters.get("runtime.compiles"))
+        or _num(compile_led.get("total_compiles")),
+        "top_recompilers": _top_recompilers(compile_led),
+        "devices": dev_rows,
+        "alerts_total": _num(alerts.get("total")),
+    }
+
+
+def _top_recompilers(compile_led: Dict[str, Any], k: int = 3) -> List[Dict[str, Any]]:
+    fns = compile_led.get("functions") or {}
+    rows = [
+        {"fn": fn, "compiles": int(slot.get("compiles") or 0)}
+        for fn, slot in fns.items()
+        if isinstance(slot, dict)
+    ]
+    rows.sort(key=lambda r: (-r["compiles"], r["fn"]))
+    return rows[:k]
+
+
+def _device_balance(
+    rows: Mapping[str, Dict[str, Any]]
+) -> Tuple[Optional[float], Optional[float]]:
+    """(utilization, skew) over every device of every polled endpoint.
+
+    Utilization is fleet bytes-in-use / bytes-limit where the backend
+    reports memory stats (TPU/GPU). Skew is (max-min)/max over each
+    device's *busy* bytes — ``bytes_in_use`` when available, else the
+    ``live_bytes`` census (the CPU-visible signal) — the imbalance
+    number a config-sharded mesh must hold near zero.
+    """
+    in_use_total = 0.0
+    limit_total = 0.0
+    busy: List[float] = []
+    for row in rows.values():
+        for d in (row.get("devices") or {}).values():
+            iu = _num(d.get("bytes_in_use"))
+            lim = _num(d.get("bytes_limit"))
+            lv = _num(d.get("live_bytes"))
+            if iu is not None and lim:
+                in_use_total += iu
+                limit_total += lim
+            b = iu if iu is not None else lv
+            if b is not None:
+                busy.append(b)
+    utilization = (in_use_total / limit_total) if limit_total else None
+    skew = None
+    if busy:
+        hi = max(busy)
+        skew = 0.0 if hi <= 0 else (hi - min(busy)) / hi
+    return utilization, skew
+
+
+def derive_fleet(
+    rows: Mapping[str, Dict[str, Any]],
+    ok: int,
+    stale: int,
+    lost: int,
+    churn_events: int,
+) -> Dict[str, Any]:
+    """Fold per-endpoint rows into the derived fleet gauges of one round.
+
+    Pure function of its inputs (no clocks, no registry) so the offline
+    scan and the tests compute exactly what the live collector publishes;
+    rate/trend fields are filled in by the collector, which owns the
+    window."""
+    utilization, skew = _device_balance(rows)
+
+    def _sum(field: str) -> Optional[float]:
+        vals = [_num(r.get(field)) for r in rows.values()]
+        vals = [v for v in vals if v is not None]
+        return sum(vals) if vals else None
+
+    # the dispatcher's workers_alive gauge is ping-loop-paced (can lag a
+    # whole ping_interval behind); the endpoint census itself is the
+    # fallback truth — every ok endpoint announcing component=worker
+    workers_alive = _sum("workers_alive")
+    if workers_alive is None and rows:
+        workers_alive = float(sum(
+            1 for r in rows.values()
+            if r.get("ok") and r.get("component") == "worker"
+        ))
+
+    return {
+        "endpoints": len(rows),
+        "ok": ok,
+        "stale": stale,
+        "lost": lost,
+        "churn_events": churn_events,
+        "workers_alive": workers_alive,
+        "queue_depth": _sum("queue_depth"),
+        "jobs_in_flight": _sum("jobs_in_flight"),
+        "compiles": _sum("compiles"),
+        "device_mem_utilization": (
+            round(utilization, 4) if utilization is not None else None
+        ),
+        "device_mem_skew": round(skew, 4) if skew is not None else None,
+    }
+
+
+EndpointSpec = Union[
+    Sequence[str],
+    Mapping[str, str],
+    Callable[[], Mapping[str, str]],
+]
+
+
+class FleetCollector:
+    """Poll ``obs_snapshot`` endpoints into a windowed fleet time series.
+
+    ``endpoints`` is a list of URIs, a ``{name: uri}`` mapping, or a
+    zero-arg callable returning one — the callable form is how the
+    master tracks an *elastic* fleet (workers join and leave between
+    rounds; the collector re-reads the listing every round and keeps
+    staleness state per URI).
+
+    ``poll_once()`` runs one round synchronously (tests and the ``top``
+    CLI drive it directly); ``start()`` spawns the daemon poll thread.
+    Every round is bounded: each endpoint gets its own ``timeout_s``
+    socket timeout, failures are recorded as the gap they are, and
+    nothing propagates out of the loop.
+    """
+
+    def __init__(
+        self,
+        endpoints: EndpointSpec,
+        interval_s: float = 2.0,
+        series_path: Optional[str] = None,
+        timeout_s: Optional[float] = None,
+        window: int = 256,
+        stale_after_s: Optional[float] = None,
+        churn_window_s: float = 600.0,
+        lost_after_failures: int = 2,
+        registry: Optional[MetricsRegistry] = None,
+        bus: Optional[E.EventBus] = None,
+        series_max_bytes: int = 16 * 1024 * 1024,
+        series_max_files: int = 3,
+        fetch: Optional[Callable[[str, float], Dict[str, Any]]] = None,
+    ):
+        self.interval_s = max(float(interval_s), 0.05)
+        #: per-endpoint socket timeout; defaults to the poll interval
+        #: (capped at 5 s) so one hung peer cannot eat multiple rounds
+        self.timeout_s = (
+            float(timeout_s) if timeout_s is not None
+            else min(max(self.interval_s, 0.5), 5.0)
+        )
+        #: an endpoint unpolled this long is *stale* even if the last
+        #: attempt nominally succeeded (default: 3 poll intervals)
+        self.stale_after_s = (
+            float(stale_after_s) if stale_after_s is not None
+            else 3.0 * self.interval_s
+        )
+        self.churn_window_s = float(churn_window_s)
+        #: consecutive failed polls before a once-ok endpoint counts as a
+        #: churn event — one missed round is routinely a GIL stall (the
+        #: peer's reply thread blocked behind an XLA compile), not a death
+        self.lost_after_failures = max(int(lost_after_failures), 1)
+        self._endpoints_spec = endpoints
+        self._registry = registry
+        self._bus = bus
+        self._fetch = fetch if fetch is not None else _rpc_fetch
+        self._lock = threading.Lock()
+        self._states: Dict[str, EndpointState] = {}
+        self._window: collections.deque = collections.deque(
+            maxlen=max(int(window), 2)
+        )
+        #: monotonic stamps of churn events (drops + endpoint losses)
+        self._churn_times: collections.deque = collections.deque(maxlen=1024)
+        self._seq = 0
+        self._journal: Optional[JsonlJournal] = None
+        if series_path is not None:
+            self._journal = JsonlJournal(
+                series_path, max_bytes=series_max_bytes,
+                max_files=series_max_files,
+            )
+        self.series_path = series_path
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._pool: Optional[Any] = None
+
+    # ------------------------------------------------------------- endpoints
+    def _resolve_endpoints(self) -> Dict[str, str]:
+        spec = self._endpoints_spec
+        try:
+            listing = spec() if callable(spec) else spec
+        except Exception:
+            # an elastic listing source mid-teardown must not kill the loop
+            logger.exception("fleet collector endpoint listing failed")
+            with self._lock:
+                return {name: st.uri for name, st in self._states.items()}
+        if isinstance(listing, Mapping):
+            return {str(k): str(v) for k, v in listing.items()}
+        return {str(u): str(u) for u in (listing or ())}
+
+    # ----------------------------------------------------------------- round
+    def poll_once(self) -> Dict[str, Any]:
+        """One poll round; returns (and retains) the ``fleet_sample``
+        record that was written/emitted."""
+        now_mono = time.monotonic()
+        listing = self._resolve_endpoints()
+        unlisted_lost = 0
+        with self._lock:
+            for name, uri in listing.items():
+                st = self._states.get(name)
+                if st is None or st.uri != uri:
+                    # a same-name listing whose URI moved is a restart:
+                    # the old endpoint is gone, which is churn exactly
+                    # like an unlisted one (a worker flapping onto a
+                    # fresh port each cycle must not read as zero churn)
+                    if st is not None and st.ever_ok:
+                        self._churn_times.append(now_mono)
+                        unlisted_lost += 1
+                    self._states[name] = EndpointState(name, uri)
+            # unlisted endpoints are forgotten — an elastic fleet shrinks;
+            # a vanished-but-once-ok endpoint counts as churn (tallied
+            # into this round's lost/churn_events below, so the sample's
+            # fields agree with the rate they feed)
+            for name in list(self._states):
+                if name not in listing:
+                    st = self._states.pop(name)
+                    if st.ever_ok:
+                        self._churn_times.append(now_mono)
+                        unlisted_lost += 1
+            targets = list(self._states.values())
+
+        rows: Dict[str, Dict[str, Any]] = {}
+        ok = stale = streak_lost = 0
+        drops_delta = 0.0
+        # endpoints poll CONCURRENTLY: N unreachable peers cost one
+        # socket timeout of wall clock, not N serial ones — the round
+        # stays bounded however much of the fleet is down (each endpoint
+        # has exactly one poller thread; state writes don't race)
+        if len(targets) > 1:
+            results = list(self._ensure_pool().map(
+                self._poll_endpoint, targets))
+        else:
+            results = [self._poll_endpoint(st) for st in targets]
+        for st, (row, churned, drop_delta) in zip(targets, results):
+            rows[st.name] = row
+            if row["ok"]:
+                ok += 1
+            if churned:
+                streak_lost += 1
+            drops_delta += drop_delta
+            stale_s = row.get("stale_s")
+            if stale_s is not None and stale_s > self.stale_after_s:
+                stale += 1
+        # losses this round = endpoints unlisted (stamped above) +
+        # failure streaks; worker drops observed by the dispatcher count
+        # into the same churn stream (one monotonic stamp per event —
+        # unlisted stamps were already appended in the listing block)
+        lost = unlisted_lost + streak_lost
+        churn_events = lost + int(drops_delta)
+        now_mono = time.monotonic()
+        with self._lock:
+            for _ in range(int(drops_delta) + streak_lost):
+                self._churn_times.append(now_mono)
+            churn_per_min = self._churn_per_min_locked(now_mono)
+
+        fleet = derive_fleet(rows, ok=ok, stale=stale, lost=lost,
+                             churn_events=churn_events)
+        fleet["worker_churn_per_min"] = churn_per_min
+        sample = {
+            "event": "fleet_sample",
+            "t_wall": time.time(),
+            "t_mono": now_mono,
+            "seq": self._seq,
+            "fleet": fleet,
+            "endpoints": {name: rows[name] for name in sorted(rows)},
+        }
+        self._seq += 1
+        with self._lock:
+            self._window.append(sample)
+            trend, compile_rate = self._trends_locked()
+        fleet["queue_depth_trend_per_min"] = trend
+        fleet["compile_rate_per_min"] = compile_rate
+        self._publish(fleet)
+        if self._journal is not None:
+            try:
+                # sort_keys: two collectors over the same fleet state
+                # produce byte-identical lines (modulo clocks) — the
+                # determinism bar the series readers rely on
+                self._journal.write_record(_sorted_record(sample))
+            except Exception:
+                logger.exception("fleet series write failed")
+        bus = self._bus if self._bus is not None else E.get_bus()
+        bus.emit(E.FLEET_SAMPLE, **_flat_fields(sample))
+        return sample
+
+    def _poll_endpoint(
+        self, st: EndpointState
+    ) -> Tuple[Dict[str, Any], bool, float]:
+        """Poll one endpoint; returns (series row, lost-this-round,
+        dispatcher worker-drop delta). Never raises."""
+        snap: Optional[Dict[str, Any]] = None
+        distilled: Optional[Dict[str, Any]] = None
+        t0 = time.monotonic()
+        try:
+            snap = self._fetch(st.uri, self.timeout_s)
+            # distilling INSIDE the try: a version-skewed peer answering
+            # with an unexpected structure is a gap, not a collector
+            # crash — the 'never raises' contract covers both steps
+            distilled = _endpoint_row(snap)
+        except Exception as e:
+            st.ok = False
+            st.consecutive_failures += 1
+            st.last_error = f"{type(e).__name__}: {e}"
+        drop_delta = 0.0
+        if distilled is not None:
+            st.ok = True
+            st.ever_ok = True
+            st.consecutive_failures = 0
+            st.last_error = None
+            st.last_ok_mono = time.monotonic()
+            st.last_snapshot = snap
+        now_mono = time.monotonic()
+        row: Dict[str, Any] = {
+            "uri": st.uri,
+            "ok": st.ok,
+            "poll_s": round(now_mono - t0, 6),
+            "stale_s": (
+                round(st.stale_s(now_mono), 3)
+                if st.stale_s(now_mono) is not None else None
+            ),
+            "consecutive_failures": st.consecutive_failures,
+            "error": st.last_error,
+        }
+        if distilled is not None:
+            dropped = _num(distilled.get("workers_dropped"))
+            if dropped is not None:
+                prev = st.last_counters.get("workers_dropped")
+                if prev is not None and dropped > prev:
+                    drop_delta = dropped - prev
+                st.last_counters["workers_dropped"] = dropped
+            row.update(distilled)
+        # a churn event fires once per failure STREAK, and only after
+        # lost_after_failures consecutive misses of a once-ok endpoint
+        # (one missed round is routinely a peer GIL-stalled in a compile)
+        lost = (
+            st.ever_ok
+            and st.consecutive_failures == self.lost_after_failures
+        )
+        return row, lost, drop_delta
+
+    # ------------------------------------------------------------- windows
+    def _churn_per_min_locked(self, now_mono: float) -> float:
+        while self._churn_times and now_mono - self._churn_times[0] > self.churn_window_s:
+            self._churn_times.popleft()
+        # fixed-window denominator: events / churn_window_s — a freshly
+        # started collector must not report one early drop as a storm
+        return round(len(self._churn_times) * 60.0 / self.churn_window_s, 4)
+
+    def _trends_locked(self) -> Tuple[Optional[float], Optional[float]]:
+        """(queue-depth slope per minute, compile rate per minute) over
+        the in-memory window — newest minus oldest over elapsed time,
+        which is robust to missed rounds in a way per-round deltas are
+        not."""
+        if len(self._window) < 2:
+            return None, None
+        first, last = self._window[0], self._window[-1]
+        dt = last["t_mono"] - first["t_mono"]
+        if dt <= 0:
+            return None, None
+
+        def rate(field: str, monotone: bool) -> Optional[float]:
+            a = _num(first["fleet"].get(field))
+            b = _num(last["fleet"].get(field))
+            if a is None or b is None:
+                return None
+            delta = b - a
+            if monotone and delta < 0:
+                # a counter went backwards: an endpoint restarted — treat
+                # the window as unmeasurable rather than report negative
+                return None
+            return round(delta * 60.0 / dt, 4)
+
+        return rate("queue_depth", False), rate("compiles", True)
+
+    def _publish(self, fleet: Dict[str, Any]) -> None:
+        reg = self._registry if self._registry is not None else get_metrics()
+        for field, gauge in (
+            ("endpoints", "fleet.endpoints"),
+            ("ok", "fleet.endpoints_ok"),
+            ("stale", "fleet.endpoints_stale"),
+            ("workers_alive", "fleet.workers_alive"),
+            ("queue_depth", "fleet.queue_depth"),
+            ("jobs_in_flight", "fleet.jobs_in_flight"),
+            ("device_mem_utilization", "fleet.device_mem_utilization"),
+            ("device_mem_skew", "fleet.device_mem_skew"),
+            ("worker_churn_per_min", "fleet.worker_churn_per_min"),
+            ("queue_depth_trend_per_min", "fleet.queue_depth_trend_per_min"),
+            ("compile_rate_per_min", "fleet.compile_rate_per_min"),
+        ):
+            v = _num(fleet.get(field))
+            if v is not None:
+                reg.gauge(gauge).set(v)
+            else:
+                # a gauge whose source became unmeasurable must be
+                # dropped, not frozen: a dead dispatcher's last
+                # queue_depth would otherwise serve as live forever
+                reg.remove(gauge)
+        reg.counter("fleet.poll_rounds").inc()
+
+    def _ensure_pool(self) -> Any:
+        """The persistent poller pool: threads are reused across rounds
+        (per-round executor spawn/join would tax every 2 s duty cycle
+        for the life of the sweep). Capacity 16 caps the concurrent
+        socket count; threads only materialize on demand."""
+        with self._lock:
+            if self._pool is None:
+                import concurrent.futures
+
+                self._pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=16, thread_name_prefix="obs-fleet-poll"
+                )
+            return self._pool
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "FleetCollector":
+        """Spawn the daemon poll thread (idempotent)."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="obs-fleet-collector"
+            )
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            t0 = time.monotonic()
+            try:
+                self.poll_once()
+            except Exception:
+                # the observatory must never take the fleet down
+                logger.exception("fleet collector poll round failed")
+            # drift-free cadence: the round's own cost comes out of the
+            # wait, so the effective period stays ~interval_s even when
+            # part of the fleet is timing out
+            elapsed = time.monotonic() - t0
+            if self._stop.wait(max(self.interval_s - elapsed, 0.05)):
+                return
+
+    def stop(self) -> None:
+        """Stop the poll thread and close the series file (idempotent)."""
+        self._stop.set()
+        with self._lock:
+            thread, self._thread = self._thread, None
+            pool, self._pool = self._pool, None
+        if thread is not None:
+            thread.join(timeout=max(2 * self.timeout_s, 5.0))
+        if pool is not None:
+            # don't block on a hung peer's in-flight poll; its thread is
+            # daemon-irrelevant once the loop is down
+            pool.shutdown(wait=False)
+        if self._journal is not None:
+            self._journal.close()
+
+    def __enter__(self) -> "FleetCollector":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+    # ----------------------------------------------------------- inspection
+    def window(self) -> List[Dict[str, Any]]:
+        """Oldest-first copy of the in-memory sample window."""
+        with self._lock:
+            return list(self._window)
+
+    def last_sample(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._window[-1] if self._window else None
+
+    def last_snapshots(self) -> Dict[str, Optional[Dict[str, Any]]]:
+        """Newest full ``obs_snapshot`` per endpoint (None before the
+        first success) — what ``watch --snapshot`` renders per row."""
+        with self._lock:
+            return {
+                name: st.last_snapshot
+                for name, st in sorted(self._states.items())
+            }
+
+    def endpoint_states(self) -> Dict[str, Dict[str, Any]]:
+        """Staleness view per endpoint (the ``watch --snapshot`` merge)."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                name: {
+                    "uri": st.uri,
+                    "ok": st.ok,
+                    "stale_s": st.stale_s(now),
+                    "consecutive_failures": st.consecutive_failures,
+                    "error": st.last_error,
+                }
+                for name, st in sorted(self._states.items())
+            }
+
+
+def _sorted_record(x: Any) -> Any:
+    """Recursively key-sort dicts so a series line's byte layout is a
+    function of its content only."""
+    if isinstance(x, dict):
+        return {k: _sorted_record(x[k]) for k in sorted(x)}
+    if isinstance(x, (list, tuple)):
+        return [_sorted_record(v) for v in x]
+    return x
+
+
+def _flat_fields(sample: Dict[str, Any]) -> Dict[str, Any]:
+    """The bus-event form of one sample: derived fleet gauges flattened
+    to top-level fields (what the anomaly rules key on) plus the compact
+    endpoint census."""
+    fleet = sample["fleet"]
+    return {
+        "seq": sample["seq"],
+        **{k: v for k, v in fleet.items()},
+        "endpoint_names": sorted(sample["endpoints"]),
+    }
+
+
+def read_series(path: str) -> List[Dict[str, Any]]:
+    """Read a (possibly rotated) series file, oldest first — the same
+    reader contract as run journals (corrupt lines skipped)."""
+    from hpbandster_tpu.obs.journal import read_journal
+
+    return [r for r in read_journal(path) if r.get("event") == "fleet_sample"]
+
+
+def read_series_tail(
+    path: str, max_scan_bytes: int = 1 << 20
+) -> Optional[Dict[str, Any]]:
+    """Newest ``fleet_sample`` without parsing the whole rotated set —
+    a refreshing dashboard needs one frame per tick, not the history.
+    Scans only the live file's final bytes; falls back to the full
+    :func:`read_series` when the live file holds no complete sample
+    (e.g. freshly rotated)."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(size - max_scan_bytes, 0))
+            chunk = f.read()
+    except OSError:
+        chunk = b""
+    for line in reversed(chunk.splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            # a truncated first line of the scan window, or a corrupt
+            # line — same skip contract as read_journal
+            continue
+        if isinstance(rec, dict) and rec.get("event") == "fleet_sample":
+            return rec
+    recs = read_series(path)
+    return recs[-1] if recs else None
+
+
+# ------------------------------------------------------------------ top view
+def _fmt(v: Any, nd: int = 0, dash: str = "-") -> str:
+    if v is None:
+        return dash
+    if isinstance(v, float):
+        return f"{v:.{nd}f}" if nd else f"{v:g}"
+    return str(v)
+
+
+def format_fleet_table(sample: Dict[str, Any]) -> str:
+    """Render one ``fleet_sample`` as the ``obs top`` fleet table."""
+    fleet = sample.get("fleet") or {}
+    lines = [
+        "fleet: endpoints {}/{} ok ({} stale)  workers={}  queue={}  "
+        "in_flight={}".format(
+            _fmt(fleet.get("ok")), _fmt(fleet.get("endpoints")),
+            _fmt(fleet.get("stale")), _fmt(fleet.get("workers_alive")),
+            _fmt(fleet.get("queue_depth")), _fmt(fleet.get("jobs_in_flight")),
+        ),
+        "       mem_util={}  mem_skew={}  churn/min={}  queue_trend/min={}  "
+        "compiles/min={}".format(
+            _fmt(fleet.get("device_mem_utilization"), 3),
+            _fmt(fleet.get("device_mem_skew"), 3),
+            _fmt(fleet.get("worker_churn_per_min"), 2),
+            _fmt(fleet.get("queue_depth_trend_per_min"), 2),
+            _fmt(fleet.get("compile_rate_per_min"), 2),
+        ),
+        "",
+    ]
+    header = (
+        f"{'endpoint':<18} {'comp':<10} {'ok':<3} {'up_s':>8} "
+        f"{'stale_s':>8} {'inflight':<14} {'alerts':>6} {'compiles':>8}  "
+        f"top_recompilers"
+    )
+    lines.append(header)
+    lines.append("-" * len(header))
+    for name, row in sorted((sample.get("endpoints") or {}).items()):
+        in_flight = row.get("in_flight")
+        if isinstance(in_flight, dict):
+            in_flight = ",".join(
+                f"{k}={v}" for k, v in sorted(in_flight.items())
+                if not isinstance(v, (list, dict))
+            ) or "busy"
+        recomp = " ".join(
+            f"{r['fn']}x{r['compiles']}"
+            for r in (row.get("top_recompilers") or [])
+        )
+        lines.append(
+            f"{name[:18]:<18} {str(row.get('component') or '?')[:10]:<10} "
+            f"{'y' if row.get('ok') else 'N':<3} "
+            f"{_fmt(row.get('uptime_s'), 1):>8} "
+            f"{_fmt(row.get('stale_s'), 1):>8} "
+            f"{str(in_flight if in_flight is not None else '-')[:14]:<14} "
+            f"{_fmt(row.get('alerts_total')):>6} "
+            f"{_fmt(row.get('compiles')):>8}  {recomp}"
+        )
+    return "\n".join(lines)

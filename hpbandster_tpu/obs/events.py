@@ -58,6 +58,7 @@ __all__ = [
     "PROMOTION_DECISION",
     "ALERT",
     "XLA_COMPILE",
+    "FLEET_SAMPLE",
 ]
 
 logger = logging.getLogger("hpbandster_tpu.obs")
@@ -86,6 +87,9 @@ ALERT = "alert"
 #: compilation a ``tracked_jit`` boundary observed — fn name, abstract
 #: shape signature, compile seconds, per-function recompile count
 XLA_COMPILE = "xla_compile"
+#: one fleet-collector poll round (obs/collector.py): derived fleet
+#: gauges — endpoint census, device balance, churn and trend rates
+FLEET_SAMPLE = "fleet_sample"
 
 #: the core vocabulary (docs/observability.md "Event schema"). emit() also
 #: accepts names outside this set — subsystems may add their own (span
@@ -94,7 +98,7 @@ EVENT_TYPES = frozenset({
     JOB_SUBMITTED, JOB_STARTED, JOB_FINISHED, JOB_FAILED,
     WORKER_DISCOVERED, WORKER_DROPPED, BRACKET_PROMOTION, KDE_REFIT,
     RPC_RETRY, RESULT_DELIVERED, CHECKPOINT_WRITTEN, UNKNOWN_RESULT,
-    CONFIG_SAMPLED, PROMOTION_DECISION, ALERT, XLA_COMPILE,
+    CONFIG_SAMPLED, PROMOTION_DECISION, ALERT, XLA_COMPILE, FLEET_SAMPLE,
 })
 
 #: process-wide kill switch (hpbandster_tpu.obs.set_enabled)

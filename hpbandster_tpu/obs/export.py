@@ -78,6 +78,13 @@ _LABEL_RULES: Tuple[Tuple[re.Pattern, str, str], ...] = (
      "runtime_device_{field}", "device"),
     (re.compile(r"^runtime\.compiles\.(?P<label>.+)$", re.DOTALL),
      "runtime_fn_compiles", "fn"),
+    # roofline/cost families (obs/runtime.py _TrackedLowered cost
+    # analysis): per-program FLOPs and bytes re-expressed as one labeled
+    # family each, so a scraper can sum/aggregate across functions
+    (re.compile(r"^runtime\.flops\.(?P<label>.+)$", re.DOTALL),
+     "runtime_fn_flops", "fn"),
+    (re.compile(r"^runtime\.bytes_accessed\.(?P<label>.+)$", re.DOTALL),
+     "runtime_fn_bytes_accessed", "fn"),
     (re.compile(r"^anomaly\.alerts\.(?P<label>.+)$", re.DOTALL),
      "anomaly_rule_alerts", "rule"),
     (re.compile(

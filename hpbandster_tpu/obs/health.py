@@ -140,12 +140,38 @@ class HealthEndpoint:
 
         return render_registry(self._registry)
 
+    # ------------------------------------------------------ deep profiling
+    def start_profile(self, log_dir: Optional[str] = None) -> Dict[str, Any]:
+        """The ``start_profile`` RPC body: begin a ``jax.profiler`` trace
+        capture in THIS process (obs/profile.py) — remote, on demand,
+        no construction-time ``profile_dir`` required."""
+        from hpbandster_tpu.obs.profile import get_profile_session
+
+        return get_profile_session().start(log_dir=log_dir)
+
+    def stop_profile(self) -> Dict[str, Any]:
+        """The ``stop_profile`` RPC body: end the live capture; reports
+        the trace dir, duration, and file count."""
+        from hpbandster_tpu.obs.profile import get_profile_session
+
+        return get_profile_session().stop()
+
+    def profile_status(self) -> Dict[str, Any]:
+        from hpbandster_tpu.obs.profile import get_profile_session
+
+        return get_profile_session().status()
+
     def register(self, server: Any) -> None:
-        """Expose :meth:`snapshot` as the ``obs_snapshot`` RPC method and
-        :meth:`metrics_text` as ``metrics_text`` — every fleet process is
-        scrapeable through its existing health port."""
+        """Expose :meth:`snapshot` as the ``obs_snapshot`` RPC method,
+        :meth:`metrics_text` as ``metrics_text``, and the on-demand
+        profiling trio (``start_profile`` / ``stop_profile`` /
+        ``profile_status``) — every fleet process is scrapeable AND
+        profileable through its existing health port."""
         server.register("obs_snapshot", self.snapshot)
         server.register("metrics_text", self.metrics_text)
+        server.register("start_profile", self.start_profile)
+        server.register("stop_profile", self.stop_profile)
+        server.register("profile_status", self.profile_status)
 
 
 def install_crash_dump(

@@ -1,0 +1,338 @@
+"""On-demand deep profiling: remote trace capture + roofline attribution.
+
+Before this module, capturing a ``jax.profiler`` trace of a fleet
+process meant deciding at *construction* time (``FusedBOHB(profile_dir=
+...)`` wraps the whole sweep) — there was no way to ask an already-hot
+worker "show me the next thirty seconds". Two pieces fix that:
+
+* :class:`ProfileSession` — a thread-safe wrapper over
+  ``jax.profiler.start_trace`` / ``stop_trace`` with a process-wide
+  default instance. Every :class:`~hpbandster_tpu.obs.health
+  .HealthEndpoint` registers it as ``start_profile`` / ``stop_profile``
+  / ``profile_status`` RPCs, so any fleet peer can be told to capture a
+  trace *now*, remotely, and report where the files landed. Errors come
+  back as ``{"ok": False, "error": ...}`` dicts, never as exceptions —
+  a profiling request must not be able to take a serving process down.
+
+* :func:`roofline_report` — walks the AOT compile ledger
+  (:class:`~hpbandster_tpu.obs.runtime.CompileTracker`), whose
+  ``_TrackedLowered`` proxy now records each compiled program's
+  ``cost_analysis()`` (FLOPs + bytes accessed), and attributes
+  arithmetic intensity per bucketed program: which programs are
+  compute-bound vs memory-bound on this chip, and — given measured
+  execution seconds — achieved-vs-peak utilization. Peak FLOP/s comes
+  from ``workloads/flops.py``'s per-chip table; HBM bandwidth from the
+  table below. **CPU caveat** (docs/observability.md): XLA's CPU
+  backend still reports FLOPs/bytes, but there are no peak numbers for
+  arbitrary host CPUs, so ``bound``/``utilization`` are None there —
+  the intensities themselves remain exact and portable.
+
+jax loads lazily inside the functions that need it (the standard obs
+rule); importing this module costs nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from hpbandster_tpu.obs.metrics import get_metrics
+
+__all__ = [
+    "ProfileSession",
+    "get_profile_session",
+    "device_peaks",
+    "roofline_report",
+    "format_roofline",
+]
+
+#: per-chip HBM bandwidth (bytes/s) by ``device.device_kind`` prefix —
+#: the memory edge of the roofline (peak FLOP/s lives in
+#: workloads/flops.py). v5e: 819 GB/s; v5p: 2765; v4: 1228; v3: 900;
+#: v6e: 1640. Unknown kinds (CPU included) return None.
+_PEAK_HBM_BYTES_S = {
+    "TPU v6 lite": 1640e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5p": 2765e9,
+    "TPU v5": 819e9,  # bare "v5" reported by some stacks is v5e
+    "TPU v4": 1228e9,
+    "TPU v3": 900e9,
+}
+
+
+class ProfileSession:
+    """One process's on-demand ``jax.profiler`` capture state.
+
+    At most one trace is live at a time (jax's own constraint); a second
+    ``start`` reports the active capture instead of raising. All methods
+    return JSON-serializable dicts — this is an RPC surface first.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._log_dir: Optional[str] = None
+        self._t0_mono: Optional[float] = None
+        self._captures = 0
+
+    def start(self, log_dir: Optional[str] = None) -> Dict[str, Any]:
+        """Begin capturing a trace into ``log_dir`` (a fresh temp dir by
+        default, reported back so the caller can fetch/inspect it)."""
+        with self._lock:
+            if self._log_dir is not None:
+                return {
+                    "ok": False,
+                    "error": "profile already active",
+                    "log_dir": self._log_dir,
+                }
+            if log_dir is None:
+                log_dir = tempfile.mkdtemp(prefix="hpb_profile_")
+            try:
+                import jax
+
+                os.makedirs(log_dir, exist_ok=True)
+                jax.profiler.start_trace(log_dir)
+            except Exception as e:
+                # the profiler failing must never look like the process
+                # failing — report and keep serving
+                return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            self._log_dir = log_dir
+            self._t0_mono = time.monotonic()
+            get_metrics().counter("profile.captures_started").inc()
+            return {"ok": True, "log_dir": log_dir}
+
+    def stop(self) -> Dict[str, Any]:
+        """End the live capture; reports the trace dir and duration."""
+        with self._lock:
+            if self._log_dir is None:
+                return {"ok": False, "error": "no profile active"}
+            log_dir = self._log_dir
+            t0 = self._t0_mono
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception as e:
+                # keep the session marked active: jax's profiler may
+                # still hold its trace open, and clearing our state here
+                # would wedge profiling for the life of the process (no
+                # later start can succeed, no later stop would retry)
+                return {
+                    "ok": False,
+                    "error": f"{type(e).__name__}: {e}",
+                    "log_dir": log_dir,
+                }
+            self._log_dir = None
+            self._t0_mono = None
+            self._captures += 1
+            get_metrics().counter("profile.captures_completed").inc()
+            return {
+                "ok": True,
+                "log_dir": log_dir,
+                "duration_s": (
+                    round(time.monotonic() - t0, 3) if t0 is not None else None
+                ),
+                "files": _count_trace_files(log_dir),
+            }
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "active": self._log_dir is not None,
+                "log_dir": self._log_dir,
+                "elapsed_s": (
+                    round(time.monotonic() - self._t0_mono, 3)
+                    if self._t0_mono is not None else None
+                ),
+                "captures_completed": self._captures,
+            }
+
+
+def _count_trace_files(log_dir: str) -> int:
+    n = 0
+    for _dirpath, _dirnames, filenames in os.walk(log_dir):
+        n += len(filenames)
+    return n
+
+
+_SESSION = ProfileSession()
+
+
+def get_profile_session() -> ProfileSession:
+    """The process-wide session every health endpoint exposes."""
+    return _SESSION
+
+
+# ------------------------------------------------------------------ roofline
+def device_peaks(device: Any = None) -> Dict[str, Optional[float]]:
+    """``{"flops_per_s", "bytes_per_s", "ridge_flops_per_byte", "kind"}``
+    for one device (default: ``jax.devices()[0]``); values are None for
+    chips without table entries — CPU most prominently."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    from hpbandster_tpu.workloads.flops import peak_bf16_flops
+
+    kind = str(getattr(device, "device_kind", ""))
+    flops = peak_bf16_flops(device)
+    bw = None
+    for prefix, v in _PEAK_HBM_BYTES_S.items():
+        if kind.startswith(prefix):
+            bw = v
+            break
+    return {
+        "kind": kind,
+        "flops_per_s": flops,
+        "bytes_per_s": bw,
+        "ridge_flops_per_byte": (flops / bw) if flops and bw else None,
+    }
+
+
+def roofline_report(
+    tracker: Any = None,
+    peaks: Optional[Dict[str, Optional[float]]] = None,
+    seconds_by_program: Optional[Dict[str, float]] = None,
+) -> Dict[str, Any]:
+    """Attribute FLOPs/bytes per compiled program in the compile ledger.
+
+    Covers every ledger program that recorded a ``cost_analysis`` (the
+    AOT path: ``fn.lower(...).compile()`` through ``_TrackedLowered`` —
+    exactly the bucket ledger's programs). ``peaks`` defaults to
+    :func:`device_peaks` of the first local device, but never initializes
+    jax when the ledger is empty. ``seconds_by_program`` maps
+    ``"fn"`` or ``"fn@signature"`` to measured execution seconds — when
+    given, the program's achieved FLOP/s and utilization-vs-peak are
+    estimated (the *measured* half of the roofline; without it only the
+    analytic half renders).
+
+    Deterministic: programs sort by (fn, signature); content-only.
+    """
+    from hpbandster_tpu.obs.runtime import get_compile_tracker
+
+    trk = tracker if tracker is not None else get_compile_tracker()
+    costed = trk.program_costs()
+    if peaks is None and costed:
+        try:
+            peaks = device_peaks()
+        except Exception:  # graftlint: disable=swallowed-exception — no usable device is an expected state (CPU CI, no backend); the report renders with a caveat instead
+            peaks = None
+    peaks = peaks or {
+        "kind": None, "flops_per_s": None, "bytes_per_s": None,
+        "ridge_flops_per_byte": None,
+    }
+    peak_f = peaks.get("flops_per_s")
+    peak_b = peaks.get("bytes_per_s")
+    ridge = peaks.get("ridge_flops_per_byte")
+    programs: List[Dict[str, Any]] = []
+    for entry in costed:
+        flops = entry.get("flops")
+        nbytes = entry.get("bytes_accessed")
+        intensity = (
+            round(flops / nbytes, 4) if flops and nbytes else None
+        )
+        bound = None
+        if intensity is not None and ridge:
+            bound = "compute" if intensity >= ridge else "memory"
+        # the floor execution time the chip's rooflines allow — what the
+        # measured seconds are judged against
+        floor_s = None
+        if flops is not None and peak_f:
+            floor_s = flops / peak_f
+        if nbytes is not None and peak_b:
+            mem_s = nbytes / peak_b
+            floor_s = mem_s if floor_s is None else max(floor_s, mem_s)
+        row = {
+            "fn": entry["fn"],
+            "signature": entry.get("signature"),
+            "compiles": entry.get("compiles"),
+            "compile_s": entry.get("compile_s"),
+            "flops": flops,
+            "bytes_accessed": nbytes,
+            "intensity_flops_per_byte": intensity,
+            "bound": bound,
+            "roofline_floor_s": (
+                round(floor_s, 9) if floor_s is not None else None
+            ),
+        }
+        seconds = None
+        if seconds_by_program:
+            key = f"{entry['fn']}@{entry.get('signature')}"
+            seconds = seconds_by_program.get(key)
+            if seconds is None:
+                seconds = seconds_by_program.get(entry["fn"])
+        if seconds and flops:
+            achieved = flops / seconds
+            row["measured_s"] = round(float(seconds), 6)
+            row["achieved_flops_per_s"] = round(achieved, 2)
+            if peak_f:
+                row["utilization_vs_peak"] = round(achieved / peak_f, 4)
+        programs.append(row)
+    programs.sort(key=lambda r: (r["fn"], str(r["signature"])))
+    return {
+        "peak": peaks,
+        "programs": programs,
+        "program_count": len(programs),
+        "caveats": [] if peak_f else [
+            "no peak FLOP/s table entry for this device kind "
+            "(CPU backends especially): intensities are exact, but "
+            "bound/utilization columns cannot be computed"
+        ],
+    }
+
+
+def format_roofline(report: Dict[str, Any]) -> str:
+    """Human rendering of :func:`roofline_report` (the ``obs roofline``
+    CLI body)."""
+    peak = report.get("peak") or {}
+    lines = [
+        "roofline — device: {} (peak {} FLOP/s, {} B/s, ridge {} FLOP/B)".format(
+            peak.get("kind") or "?",
+            _si(peak.get("flops_per_s")), _si(peak.get("bytes_per_s")),
+            _fmtnum(peak.get("ridge_flops_per_byte")),
+        )
+    ]
+    header = (
+        f"{'program':<38} {'flops':>10} {'bytes':>10} {'FLOP/B':>8} "
+        f"{'bound':<8} {'floor_s':>11} {'util':>6}"
+    )
+    lines.append(header)
+    lines.append("-" * len(header))
+    for row in report.get("programs") or []:
+        name = row["fn"]
+        sig = row.get("signature")
+        if sig:
+            name = f"{name}[{sig}]"
+        util = row.get("utilization_vs_peak")
+        lines.append(
+            f"{name[:38]:<38} {_si(row.get('flops')):>10} "
+            f"{_si(row.get('bytes_accessed')):>10} "
+            f"{_fmtnum(row.get('intensity_flops_per_byte')):>8} "
+            f"{str(row.get('bound') or '-'):<8} "
+            f"{_fmtnum(row.get('roofline_floor_s')):>11} "
+            f"{(f'{100 * util:.1f}%' if util is not None else '-'):>6}"
+        )
+    if not report.get("programs"):
+        lines.append("(no costed programs in the compile ledger — run an "
+                     "AOT-compiled path first, e.g. a bucketed schedule)")
+    for c in report.get("caveats") or []:
+        lines.append(f"note: {c}")
+    return "\n".join(lines)
+
+
+def _si(v: Any) -> str:
+    if not isinstance(v, (int, float)) or isinstance(v, bool):
+        return "-"
+    v = float(v)
+    for div, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{suffix}"
+    return f"{v:.0f}"
+
+
+def _fmtnum(v: Any) -> str:
+    if not isinstance(v, (int, float)) or isinstance(v, bool):
+        return "-"
+    return f"{float(v):.3g}"

@@ -42,6 +42,7 @@ owns that compile.
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
 import time
@@ -76,11 +77,20 @@ class CompileTracker:
     and over, which is the true cost XLA pays.
     """
 
+    #: per-ledger bound on retained costed programs — a shape-churning
+    #: pathology must not grow the roofline table without limit (the
+    #: storm is the recompile counters' job to surface)
+    MAX_COSTED_PROGRAMS = 256
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         #: label -> {"compiles": int, "compile_s": float,
         #:           "last_signature": str, "last_compile_s": float}
         self._fns: Dict[str, Dict[str, Any]] = {}
+        #: (label, signature) -> {"compiles", "compile_s", "flops",
+        #: "bytes_accessed"} for programs whose compile reported a
+        #: cost_analysis (the AOT path) — what roofline_report walks
+        self._programs: "collections.OrderedDict" = collections.OrderedDict()
 
     def record(
         self,
@@ -89,11 +99,16 @@ class CompileTracker:
         seconds: float,
         registry: Optional[MetricsRegistry] = None,
         bus: Optional[E.EventBus] = None,
+        cost: Optional[Dict[str, float]] = None,
     ) -> int:
         """Count one fresh compilation of ``label``; returns the
         function's cumulative compile count. Updates the shared metrics
         (``runtime.compiles``, per-fn counters, ``runtime.compile_seconds``)
-        and emits one ``xla_compile`` event."""
+        and emits one ``xla_compile`` event. ``cost`` is the compiled
+        program's XLA cost analysis (``{"flops", "bytes_accessed"}``
+        where reported): retained per (label, signature) for the
+        roofline report and republished as ``runtime.flops.<fn>`` /
+        ``runtime.bytes_accessed.<fn>`` counters."""
         with self._lock:
             slot = self._fns.get(label)
             if slot is None:
@@ -103,10 +118,28 @@ class CompileTracker:
             slot["last_signature"] = signature
             slot["last_compile_s"] = float(seconds)
             n = slot["compiles"]
+            if cost:
+                key = (label, signature)
+                prog = self._programs.pop(key, None)
+                if prog is None:
+                    prog = {"compiles": 0, "compile_s": 0.0}
+                prog["compiles"] += 1
+                prog["compile_s"] = round(prog["compile_s"] + float(seconds), 6)
+                prog.update({k: float(v) for k, v in cost.items()})
+                self._programs[key] = prog  # re-insert: LRU-newest
+                while len(self._programs) > self.MAX_COSTED_PROGRAMS:
+                    self._programs.popitem(last=False)
         reg = registry if registry is not None else get_metrics()
         reg.counter("runtime.compiles").inc()
         reg.counter(f"runtime.compiles.{label}").inc()
         reg.gauge("runtime.compile_seconds").inc(float(seconds))
+        extra: Dict[str, Any] = {}
+        if cost:
+            for field in ("flops", "bytes_accessed"):
+                v = cost.get(field)
+                if v is not None:
+                    reg.counter(f"runtime.{field}.{label}").inc(int(v))
+                    extra[field] = float(v)
         target = bus if bus is not None else E.get_bus()
         target.emit(
             E.XLA_COMPILE,
@@ -115,8 +148,18 @@ class CompileTracker:
             compile_s=round(float(seconds), 6),
             compiles=n,
             recompiles=n - 1,
+            **extra,
         )
         return n
+
+    def program_costs(self) -> List[Dict[str, Any]]:
+        """Every costed program in the ledger, insertion order: the
+        roofline report's input rows."""
+        with self._lock:
+            return [
+                {"fn": label, "signature": signature, **dict(prog)}
+                for (label, signature), prog in self._programs.items()
+            ]
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-serializable ledger: totals + per-function breakdown."""
@@ -142,6 +185,7 @@ class CompileTracker:
         """Drop the ledger (test isolation)."""
         with self._lock:
             self._fns.clear()
+            self._programs.clear()
 
 
 _TRACKER = CompileTracker()
@@ -376,11 +420,33 @@ class _TrackedLowered:
         self._tracker.record(
             self._label, self._signature, time.perf_counter() - t0,
             registry=self._registry, bus=self._bus,
+            cost=_extract_cost(exe),
         )
         return exe
 
     def __getattr__(self, name: str) -> Any:
         return getattr(self._lowered, name)
+
+
+def _extract_cost(compiled: Any) -> Optional[Dict[str, float]]:
+    """The compiled program's XLA cost analysis, normalized to the ledger
+    schema (``flops`` / ``bytes_accessed``). Best-effort: a backend
+    without cost analysis returns None and the compile is still tracked
+    — the roofline table just has no row for it."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # graftlint: disable=swallowed-exception — backends without cost analysis are expected; absence of a roofline row is the answer, the compile is still ledgered
+        return None
+    if isinstance(ca, (list, tuple)):  # older jax returns [dict] per device
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out: Dict[str, float] = {}
+    for src, dst in (("flops", "flops"), ("bytes accessed", "bytes_accessed")):
+        v = ca.get(src)
+        if isinstance(v, (int, float)) and not isinstance(v, bool) and v == v and v >= 0:
+            out[dst] = float(v)
+    return out or None
 
 
 # ---------------------------------------------------------- transfer counters

@@ -43,11 +43,41 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, TextIO, Tuple
 from hpbandster_tpu.obs import events as E
 from hpbandster_tpu.obs.journal import read_journal_ex
 
+#: sink-free bus for CLI-side collectors (watch/top): a viewer must not
+#: inject fleet_sample events into the process it happens to run inside
+_NULL_BUS = E.EventBus()
+
 __all__ = [
     "summarize_records", "format_summary", "summarize_path",
     "read_merged", "read_merged_ex", "trace_timelines", "watch_journal",
-    "watch_snapshot",
+    "watch_snapshot", "make_viewer_collector",
 ]
+
+
+def make_viewer_collector(uris: Sequence[str], interval: float) -> Any:
+    """A CLI viewer's collector (``watch --snapshot`` / ``top``): private
+    registry + sink-free bus, because a viewer must not publish the
+    viewed fleet's gauges or ``fleet_sample`` events into whatever
+    process it happens to run inside. Validates every URI up front — a
+    malformed one can never succeed, so fail fast (``ValueError`` names
+    the offending URI) instead of looping "waiting" forever on a typo.
+    """
+    # CLI-only imports: the obs substrate itself never pulls in the RPC
+    # transport (health.py is deliberately transport-agnostic)
+    from hpbandster_tpu.obs.collector import FleetCollector
+    from hpbandster_tpu.obs.metrics import MetricsRegistry
+    from hpbandster_tpu.parallel.rpc import parse_uri
+
+    for u in uris:
+        try:
+            parse_uri(u)
+        except ValueError as e:
+            raise ValueError(f"invalid --snapshot URI {u!r}: {e}") from e
+    return FleetCollector(
+        endpoints=list(uris), interval_s=interval,
+        timeout_s=max(interval, 2.0),
+        registry=MetricsRegistry(), bus=_NULL_BUS,
+    )
 
 #: journal-record fields -> timeline stage names (the emitting sites:
 #: dispatcher JOB_STARTED, worker JOB_FINISHED/JOB_FAILED, worker
@@ -433,7 +463,7 @@ class _WatchState:
         failed = c.get(E.JOB_FAILED, 0)
         in_flight = max(submitted - finished - failed, 0)
         if self.last_t_wall is not None:
-            age = max(time.time() - self.last_t_wall, 0.0)
+            age = max(time.time() - self.last_t_wall, 0.0)  # graftlint: disable=wallclock-duration — journal records carry another process's wall stamps; monotonic does not compare across hosts
             last = f"{self.last_name} {age:.1f}s ago"
         else:
             last = "-"
@@ -555,65 +585,80 @@ def _snapshot_runtime_part(snap: Dict[str, Any]) -> str:
     return (" runtime: " + " ".join(parts)) if parts else ""
 
 
+def _snapshot_status_line(snap: Dict[str, Any]) -> str:
+    """One endpoint's watch line body from its ``obs_snapshot``."""
+    up = snap.get("uptime_s")
+    counters = (snap.get("metrics") or {}).get("counters") or {}
+    lat = snap.get("latency") or {}
+    lat_part = " ".join(
+        f"{name}=p50:{v.get('p50'):g}/p95:{v.get('p95'):g}"
+        for name, v in sorted(lat.items())
+        if isinstance(v, dict)
+        and isinstance(v.get("p50"), (int, float))
+        and isinstance(v.get("p95"), (int, float))
+    )
+    alerts = snap.get("alerts") or {}
+    return (
+        f"{snap.get('component', '?')} up={up}s "
+        f"in_flight={json.dumps(snap.get('in_flight'))} "
+        f"counters={sum(counters.values())} "
+        f"alerts={alerts.get('total', 0)}"
+        + (f" latency: {lat_part}" if lat_part else "")
+        + _snapshot_runtime_part(snap)
+    )
+
+
 def watch_snapshot(
-    uri: str,
+    uri: "str | List[str]",
     interval: float = 2.0,
     ticks: Optional[int] = None,
     stream: Optional[TextIO] = None,
 ) -> int:
-    """Poll a live process's ``obs_snapshot`` health RPC — latency
-    without a journal on disk.
+    """Poll one or many live processes' ``obs_snapshot`` health RPCs —
+    latency without a journal on disk.
 
-    Renders the snapshot's histogram quantiles (the ``latency`` section
+    Renders each snapshot's histogram quantiles (the ``latency`` section
     :meth:`~hpbandster_tpu.obs.health.HealthEndpoint.snapshot` computes
     from the metrics registry), the in-flight work, and the anomaly
-    alert tally. An unreachable peer prints a waiting line and keeps
-    polling — the target may simply not be up yet.
+    alert tally. With several URIs (repeat ``--snapshot``), each tick
+    prints one row per endpoint, merged through the fleet collector's
+    poll/staleness machinery — an unreachable peer prints a waiting line
+    (with its staleness once it has been seen at least once) and keeps
+    polling; it may simply not be up yet, and one hung peer costs its
+    own socket timeout, never the other rows.
     """
-    # CLI-only import: the obs substrate itself never pulls in the RPC
-    # transport (health.py is deliberately transport-agnostic)
-    from hpbandster_tpu.parallel.rpc import (
-        CommunicationError,
-        RPCError,
-        RPCProxy,
-        parse_uri,
-    )
-
+    uris = [uri] if isinstance(uri, str) else list(uri)
     out = stream if stream is not None else sys.stdout
     try:
-        # a malformed URI can never succeed: fail fast as a usage error
-        # instead of looping "waiting" forever on a typo
-        parse_uri(uri)
+        collector = make_viewer_collector(uris, interval)
     except ValueError as e:
-        print(f"error: invalid --snapshot URI {uri!r}: {e}", file=sys.stderr)
+        print(f"error: {e}", file=sys.stderr)
         return 2
+    prefix_rows = len(uris) > 1
     tick = 0
     while True:
-        try:
-            snap = RPCProxy(uri, timeout=max(interval, 2.0)).call("obs_snapshot")
-            up = snap.get("uptime_s")
-            counters = (snap.get("metrics") or {}).get("counters") or {}
-            lat = snap.get("latency") or {}
-            lat_part = " ".join(
-                f"{name}=p50:{v.get('p50'):g}/p95:{v.get('p95'):g}"
-                for name, v in sorted(lat.items())
-                if isinstance(v, dict)
-                and isinstance(v.get("p50"), (int, float))
-                and isinstance(v.get("p95"), (int, float))
-            )
-            alerts = snap.get("alerts") or {}
-            status = (
-                f"{snap.get('component', '?')} up={up}s "
-                f"in_flight={json.dumps(snap.get('in_flight'))} "
-                f"counters={sum(counters.values())} "
-                f"alerts={alerts.get('total', 0)}"
-                + (f" latency: {lat_part}" if lat_part else "")
-                + _snapshot_runtime_part(snap)
-            )
-        except (OSError, CommunicationError, RPCError, AttributeError) as e:
-            status = f"(waiting for obs_snapshot at {uri}: {type(e).__name__})"
+        collector.poll_once()
+        states = collector.endpoint_states()
+        snaps = collector.last_snapshots()
         stamp = time.strftime("%H:%M:%S")
-        print(f"[{stamp}] {status}", file=out, flush=True)
+        for name in sorted(states):
+            st = states[name]
+            snap = snaps.get(name)
+            if st["ok"] and isinstance(snap, dict):
+                status = _snapshot_status_line(snap)
+            else:
+                err = (st.get("error") or "?").split(":", 1)[0]
+                stale_s = st.get("stale_s")
+                stale_part = (
+                    f", last seen {stale_s:.0f}s ago"
+                    if isinstance(stale_s, (int, float)) else ""
+                )
+                status = (
+                    f"(waiting for obs_snapshot at {st['uri']}: "
+                    f"{err}{stale_part})"
+                )
+            row_prefix = f"{st['uri']} " if prefix_rows else ""
+            print(f"[{stamp}] {row_prefix}{status}", file=out, flush=True)
         tick += 1
         if ticks is not None and tick >= ticks:
             return 0

@@ -123,7 +123,7 @@ class TPUBatchedWorker(Worker):
         if arr.ndim != 2:
             raise ValueError(f"vectors must be [n, d], got shape {arr.shape}")
         with self._busy_lock:
-            self._last_active = time.time()
+            self._last_active = time.monotonic()
             t0 = time.perf_counter()
             with obs.span("worker_evaluate_batch", n=len(arr), budget=float(budget)):
                 losses = self.backend.evaluate(arr, float(budget))
@@ -131,7 +131,7 @@ class TPUBatchedWorker(Worker):
                 "evaluate_batch: %d configs at budget %g in %.3fs",
                 len(arr), budget, time.perf_counter() - t0,
             )
-            self._last_active = time.time()
+            self._last_active = time.monotonic()
         # stdlib json round-trips NaN/Infinity literals exactly, so crashed
         # (NaN) and diverged (+/-inf) losses survive the wire unchanged and
         # both backends agree on identical inputs

@@ -1,0 +1,48 @@
+"""Known-bad fixture for the wallclock-duration rule: every line marked
+``# BAD`` computes a duration by subtracting wall-clock readings."""
+
+import time
+from datetime import datetime
+from time import time as now
+
+
+def direct_both_sides():
+    t0 = 1.0
+    elapsed = time.time() - t0  # BAD
+    backwards = t0 - time.time()  # BAD
+    return elapsed, backwards
+
+
+def via_local_name():
+    t0 = time.time()
+    work = sum(range(10))
+    dt = time.time() - t0  # BAD
+    return work, dt
+
+
+def both_names_local():
+    start = time.time()
+    end = time.time()
+    return end - start  # BAD
+
+
+def aliased_import():
+    t0 = now()
+    return now() - t0  # BAD
+
+
+def attribute_deadline(obj):
+    # the watchdog shape: wall "now" minus a stored wall stamp
+    idle = time.time() - obj.last_active  # BAD
+    return idle > 30.0
+
+
+def inside_comparison(obj, interval):
+    if time.time() - obj.last_checkpoint > interval:  # BAD
+        return True
+    return False
+
+
+def datetime_now_delta():
+    t0 = datetime.now()
+    return datetime.now() - t0  # BAD

@@ -1,0 +1,40 @@
+"""Known-good fixture for the wallclock-duration rule: monotonic
+duration math and verbatim wall-clock timestamps are the sanctioned
+idioms; cross-process wall math carries a justified suppression."""
+
+import time
+
+
+def monotonic_duration():
+    t0 = time.monotonic()
+    work = sum(range(10))
+    return work, time.monotonic() - t0
+
+
+def perf_counter_duration():
+    t0 = time.perf_counter()
+    return time.perf_counter() - t0
+
+
+def wall_timestamp_verbatim():
+    # storing/emitting when something happened is what time.time() is FOR
+    record = {"event": "job_started", "t_wall": time.time()}
+    started_at = time.time()
+    return record, started_at
+
+
+def wall_and_mono_twins():
+    # the Job idiom: wall stamp for humans, monotonic twin for durations
+    stamps = {"wall": time.time(), "mono": time.monotonic()}
+    return stamps["mono"] - 0.0, stamps["wall"]
+
+
+def unrelated_subtraction(a, b):
+    return a - b
+
+
+def cross_process_age(record):
+    # journal records carry another host's wall stamps; monotonic clocks
+    # do not compare across processes, so wall math is the only option
+    age = time.time() - record["t_wall"]  # graftlint: disable=wallclock-duration — cross-process journal timestamp; monotonic does not compare across hosts
+    return age

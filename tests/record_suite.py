@@ -43,12 +43,12 @@ def _parse_summary(stdout: str):
 
 
 def run_lane(name: str, marker_args: list) -> dict:
-    t0 = time.time()
+    t0 = time.monotonic()
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "tests/", "-q", *marker_args],
         cwd=REPO, capture_output=True, text=True,
     )
-    wall = time.time() - t0
+    wall = time.monotonic() - t0
     tail = "\n".join(proc.stdout.strip().splitlines()[-5:])
     counts, secs = _parse_summary(proc.stdout)
     lane = {

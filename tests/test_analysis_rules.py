@@ -25,6 +25,7 @@ CASES = [
     ("obs-emit-in-jit", "obs_emit_bad.py", "obs_emit_good.py"),
     ("jit-in-loop", "jit_loop_bad.py", "jit_loop_good.py"),
     ("jit-donation", "donation_bad.py", "donation_good.py"),
+    ("wallclock-duration", "wallclock_bad.py", "wallclock_good.py"),
 ]
 
 

@@ -433,6 +433,11 @@ def _stub_tiers(monkeypatch, calls):
         lambda **kw: calls.setdefault("runtime_overhead", True)
         and {"overhead_pct": 0.01, "tracked_overhead_ns": 900.0})
     monkeypatch.setattr(
+        bench, "bench_collector_overhead",
+        lambda **kw: calls.setdefault("collector_overhead", True)
+        and {"overhead_pct": 0.6, "poll_round_s": 0.012, "n_endpoints": 3,
+             "interval_s": 2.0, "duty_cycle_pct": 0.6})
+    monkeypatch.setattr(
         bench, "bench_report_100k",
         lambda **kw: calls.setdefault("report_100k", True)
         and {"n_events": 100000, "events_per_s": 1, "deterministic": True})
@@ -588,7 +593,7 @@ class TestTierSelection:
             "cnn", "cnn_wide", "pallas", "resnet", "transformer",
             "fused10k", "chunked10k", "chunked_compile", "fused", "rpc",
             "batched", "teacher", "obs_overhead", "runtime_overhead",
-            "report_100k",
+            "collector_overhead", "report_100k",
         }
 
 

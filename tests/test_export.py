@@ -277,3 +277,63 @@ class TestCli:
         families = parse_prometheus_text(text)
         samples = families["hpbandster_runtime_fn_compiles_total"]["samples"]
         assert samples == [({"fn": "pipe_fn"}, 1.0)]
+
+
+class TestRooflineFamilies:
+    """ISSUE 7 satellite: the cost-analysis families the AOT compile
+    ledger publishes (``runtime.flops.<fn>`` / ``runtime.bytes_accessed
+    .<fn>``) export as proper labeled families and survive the strict
+    round-trip parser."""
+
+    def _registry(self):
+        reg = obs.MetricsRegistry()
+        reg.counter("runtime.flops.fused_sh_bracket_bucketed").inc(524288)
+        reg.counter("runtime.flops.refit_propose_batch_seeded").inc(1024)
+        reg.counter("runtime.bytes_accessed.fused_sh_bracket_bucketed").inc(
+            49152
+        )
+        # a pathological label needing every escape class
+        reg.counter('runtime.flops.f"x\\y\nz').inc(7)
+        return reg
+
+    def test_flops_families_are_labeled(self):
+        fam, labels = metric_family("runtime.flops.fused_bracket")
+        assert fam == "hpbandster_runtime_fn_flops"
+        assert labels == {"fn": "fused_bracket"}
+        fam, labels = metric_family("runtime.bytes_accessed.fused_bracket")
+        assert fam == "hpbandster_runtime_fn_bytes_accessed"
+        assert labels == {"fn": "fused_bracket"}
+
+    def test_round_trip_preserves_values_and_labels(self):
+        reg = self._registry()
+        text = render_registry(reg)
+        families = parse_prometheus_text(text)
+        flops = families["hpbandster_runtime_fn_flops_total"]
+        assert flops["type"] == "counter"
+        by_fn = {labels["fn"]: value for labels, value in flops["samples"]}
+        assert by_fn["fused_sh_bracket_bucketed"] == 524288.0
+        assert by_fn["refit_propose_batch_seeded"] == 1024.0
+        assert by_fn['f"x\\y\nz'] == 7.0  # escaping round-trips exactly
+        nbytes = families["hpbandster_runtime_fn_bytes_accessed_total"]
+        assert dict(
+            (labels["fn"], value) for labels, value in nbytes["samples"]
+        ) == {"fused_sh_bracket_bucketed": 49152.0}
+
+    def test_aot_ledger_to_scrape_end_to_end(self):
+        """A tracked AOT compile lands its cost in the scrape with no
+        extra wiring."""
+        import numpy as np
+
+        from hpbandster_tpu.obs.runtime import tracked_jit
+
+        reg = obs.MetricsRegistry()
+        f = tracked_jit(lambda x: x @ x.T, name="export_matmul",
+                        registry=reg, bus=obs.EventBus())
+        x = np.ones((16, 16), np.float32)
+        f.lower(x).compile()
+        families = parse_prometheus_text(render_registry(reg))
+        flops = families.get("hpbandster_runtime_fn_flops_total")
+        assert flops is not None, sorted(families)
+        (labels, value), = flops["samples"]
+        assert labels == {"fn": "export_matmul"}
+        assert value > 0

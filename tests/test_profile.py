@@ -1,0 +1,292 @@
+"""On-demand profiling + roofline tests (obs/profile.py).
+
+Covers the ProfileSession state machine, the start_profile /
+stop_profile RPC round-trip over a real health endpoint socket
+(acceptance: a non-empty trace dir), cost-analysis capture in the
+compile ledger, and the roofline report — including coverage of every
+program in a real bucket ledger (the AOT path the ISSUE names).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from hpbandster_tpu import obs
+from hpbandster_tpu.obs.profile import (
+    ProfileSession,
+    device_peaks,
+    format_roofline,
+    roofline_report,
+)
+from hpbandster_tpu.obs.runtime import CompileTracker, tracked_jit
+
+
+@pytest.fixture()
+def fresh_tracker():
+    trk = obs.get_compile_tracker()
+    trk.reset()
+    yield trk
+    trk.reset()
+
+
+class TestProfileSession:
+    def test_start_stop_round_trip_produces_files(self, tmp_path):
+        s = ProfileSession()
+        log_dir = str(tmp_path / "trace")
+        r = s.start(log_dir=log_dir)
+        assert r["ok"] and r["log_dir"] == log_dir
+        assert s.status()["active"] is True
+        jax.jit(lambda x: x * 2)(np.ones(8, np.float32))
+        r2 = s.stop()
+        assert r2["ok"]
+        assert r2["log_dir"] == log_dir
+        assert r2["files"] > 0, "trace dir must be non-empty"
+        assert r2["duration_s"] >= 0
+        assert s.status() == {
+            "active": False, "log_dir": None, "elapsed_s": None,
+            "captures_completed": 1,
+        }
+
+    def test_double_start_reports_instead_of_raising(self, tmp_path):
+        s = ProfileSession()
+        assert s.start(log_dir=str(tmp_path / "a"))["ok"]
+        r = s.start(log_dir=str(tmp_path / "b"))
+        assert r["ok"] is False
+        assert "already active" in r["error"]
+        assert r["log_dir"].endswith("a")
+        assert s.stop()["ok"]
+
+    def test_stop_without_start_is_an_error_dict(self):
+        r = ProfileSession().stop()
+        assert r == {"ok": False, "error": "no profile active"}
+
+    def test_stop_failure_keeps_session_active_for_retry(
+        self, tmp_path, monkeypatch
+    ):
+        """A stop_trace failure must NOT clear session state — jax may
+        still hold the trace open, and a cleared session would wedge
+        profiling for the life of the process (no start can succeed, no
+        stop would ever retry)."""
+        s = ProfileSession()
+        assert s.start(log_dir=str(tmp_path / "t"))["ok"]
+        real_stop = jax.profiler.stop_trace
+        monkeypatch.setattr(
+            jax.profiler, "stop_trace",
+            lambda: (_ for _ in ()).throw(RuntimeError("disk full")),
+        )
+        r = s.stop()
+        assert r["ok"] is False and "disk full" in r["error"]
+        assert s.status()["active"] is True  # retryable, not wedged
+        monkeypatch.setattr(jax.profiler, "stop_trace", real_stop)
+        r2 = s.stop()  # the retry succeeds and closes the capture
+        assert r2["ok"] is True
+        assert s.status()["active"] is False
+
+    def test_default_log_dir_is_minted_and_reported(self):
+        s = ProfileSession()
+        r = s.start()
+        assert r["ok"] and "hpb_profile_" in r["log_dir"]
+        assert s.stop()["ok"]
+
+    def test_rpc_round_trip_against_running_server(self, tmp_path):
+        """Acceptance: start_profile/stop_profile against a live health
+        endpoint over a real socket produces a non-empty trace dir."""
+        from hpbandster_tpu.parallel.rpc import RPCProxy, RPCServer
+
+        srv = RPCServer("127.0.0.1", 0)
+        obs.HealthEndpoint(component="worker").register(srv)
+        srv.start()
+        try:
+            proxy = RPCProxy(srv.uri, timeout=30)
+            log_dir = str(tmp_path / "remote_trace")
+            r = proxy.call("start_profile", log_dir=log_dir)
+            assert r["ok"], r
+            assert proxy.call("profile_status")["active"] is True
+            # device work while the capture is live
+            jax.jit(lambda x: x @ x.T)(np.ones((16, 16), np.float32))
+            r2 = proxy.call("stop_profile")
+            assert r2["ok"], r2
+            assert r2["files"] > 0
+            assert r2["log_dir"] == log_dir
+            assert proxy.call("profile_status")["active"] is False
+            # second stop: clean error, not a crash
+            assert proxy.call("stop_profile")["ok"] is False
+        finally:
+            srv.shutdown()
+
+
+class TestCostCapture:
+    def test_aot_compile_records_cost_and_counters(self, fresh_tracker):
+        reg = obs.MetricsRegistry()
+        events = []
+        bus = obs.EventBus()
+        bus.subscribe(lambda ev: events.append(ev))
+        f = tracked_jit(lambda x: x @ x.T, name="cost_matmul",
+                        registry=reg, bus=bus)
+        x = np.ones((32, 32), np.float32)
+        exe = f.lower(x).compile()
+        np.asarray(exe(x))  # the program is real, not just ledgered
+        progs = fresh_tracker.program_costs()
+        assert len(progs) == 1
+        p = progs[0]
+        assert p["fn"] == "cost_matmul"
+        assert p["flops"] > 0
+        assert p["bytes_accessed"] > 0
+        assert p["compiles"] == 1
+        # counters republished for the exporter
+        counters = reg.snapshot()["counters"]
+        assert counters["runtime.flops.cost_matmul"] == int(p["flops"])
+        assert counters["runtime.bytes_accessed.cost_matmul"] == int(
+            p["bytes_accessed"]
+        )
+        # the xla_compile event carries the cost fields
+        compile_events = [e for e in events if e.name == obs.XLA_COMPILE]
+        assert len(compile_events) == 1
+        assert compile_events[0].fields["flops"] == p["flops"]
+
+    def test_reset_clears_program_costs(self):
+        trk = CompileTracker()
+        trk.record("f", "sig", 0.1, registry=obs.MetricsRegistry(),
+                   bus=obs.EventBus(), cost={"flops": 10.0})
+        assert len(trk.program_costs()) == 1
+        trk.reset()
+        assert trk.program_costs() == []
+
+    def test_costed_program_table_is_bounded(self):
+        trk = CompileTracker()
+        reg, bus = obs.MetricsRegistry(), obs.EventBus()
+        for i in range(trk.MAX_COSTED_PROGRAMS + 10):
+            trk.record("f", f"sig{i}", 0.0, registry=reg, bus=bus,
+                       cost={"flops": 1.0})
+        assert len(trk.program_costs()) == trk.MAX_COSTED_PROGRAMS
+
+
+class TestRoofline:
+    PEAKS = {"kind": "test-chip", "flops_per_s": 100e12,
+             "bytes_per_s": 1e12, "ridge_flops_per_byte": 100.0}
+
+    def tracker_with(self, *entries):
+        trk = CompileTracker()
+        reg, bus = obs.MetricsRegistry(), obs.EventBus()
+        for label, sig, cost in entries:
+            trk.record(label, sig, 0.01, registry=reg, bus=bus, cost=cost)
+        return trk
+
+    def test_bound_classification_and_floor(self):
+        trk = self.tracker_with(
+            # intensity 200 FLOP/B > ridge 100 -> compute bound
+            ("dense", "a", {"flops": 200e9, "bytes_accessed": 1e9}),
+            # intensity 1 -> memory bound
+            ("gather", "b", {"flops": 1e9, "bytes_accessed": 1e9}),
+        )
+        rep = roofline_report(tracker=trk, peaks=self.PEAKS)
+        assert rep["program_count"] == 2
+        by_fn = {p["fn"]: p for p in rep["programs"]}
+        assert by_fn["dense"]["bound"] == "compute"
+        assert by_fn["gather"]["bound"] == "memory"
+        # compute-bound floor = flops/peak_flops
+        assert by_fn["dense"]["roofline_floor_s"] == pytest.approx(
+            200e9 / 100e12
+        )
+        # memory-bound floor = bytes/peak_bw
+        assert by_fn["gather"]["roofline_floor_s"] == pytest.approx(
+            1e9 / 1e12
+        )
+        assert rep["caveats"] == []
+
+    def test_utilization_from_measured_seconds(self):
+        trk = self.tracker_with(
+            ("dense", "a", {"flops": 1e12, "bytes_accessed": 1e9}),
+        )
+        rep = roofline_report(
+            tracker=trk, peaks=self.PEAKS,
+            seconds_by_program={"dense": 0.1},  # 10 TFLOP/s achieved
+        )
+        p = rep["programs"][0]
+        assert p["achieved_flops_per_s"] == pytest.approx(1e13)
+        assert p["utilization_vs_peak"] == pytest.approx(0.1)
+
+    def test_cpu_caveat_without_peaks(self):
+        trk = self.tracker_with(
+            ("f", "a", {"flops": 10.0, "bytes_accessed": 5.0}),
+        )
+        rep = roofline_report(
+            tracker=trk,
+            peaks={"kind": "cpu", "flops_per_s": None, "bytes_per_s": None,
+                   "ridge_flops_per_byte": None},
+        )
+        p = rep["programs"][0]
+        assert p["intensity_flops_per_byte"] == 2.0  # exact regardless
+        assert p["bound"] is None
+        assert p["roofline_floor_s"] is None
+        assert rep["caveats"], "CPU must carry the no-peak caveat"
+
+    def test_empty_ledger_never_touches_jax(self):
+        rep = roofline_report(tracker=CompileTracker())
+        assert rep["program_count"] == 0
+        text = format_roofline(rep)
+        assert "no costed programs" in text
+
+    def test_format_renders_rows(self):
+        trk = self.tracker_with(
+            ("dense", "f32[8,8]", {"flops": 2e12, "bytes_accessed": 1e9}),
+        )
+        text = format_roofline(roofline_report(tracker=trk, peaks=self.PEAKS))
+        assert "dense[f32[8,8]]" in text
+        assert "compute" in text
+        assert "test-chip" in text
+
+    def test_device_peaks_known_and_unknown_kinds(self):
+        class FakeDev:
+            device_kind = "TPU v5 lite"
+
+        peaks = device_peaks(FakeDev())
+        assert peaks["flops_per_s"] == 197e12
+        assert peaks["bytes_per_s"] == 819e9
+        assert peaks["ridge_flops_per_byte"] == pytest.approx(
+            197e12 / 819e9
+        )
+
+        class Cpu:
+            device_kind = "cpu"
+
+        assert device_peaks(Cpu())["flops_per_s"] is None
+
+    def test_roofline_covers_every_program_in_bucket_ledger(
+        self, fresh_tracker, rng
+    ):
+        """Acceptance: after a bucketed AOT schedule compiles, the
+        roofline table has a row for every program in the bucket
+        ledger."""
+        from hpbandster_tpu.ops.bracket import hyperband_schedule
+        from hpbandster_tpu.ops.buckets import (
+            build_bucket_set,
+            precompile_buckets,
+        )
+
+        def quad_eval(vec, budget):
+            return ((vec - 0.5) ** 2).sum(-1) * (1.0 + 1.0 / budget)
+
+        plans = hyperband_schedule(9, 1, 9, 3)
+        bs = build_bucket_set(plans)
+        assert len(bs.buckets) >= 1
+        handle = precompile_buckets(quad_eval, bs, d=2, background=False)
+        assert handle.wait(timeout=120)
+        progs = fresh_tracker.program_costs()
+        # every bucket program compiled through the tracked AOT proxy
+        # recorded a cost row
+        assert len(progs) == len(bs.buckets)
+        rep = roofline_report(tracker=fresh_tracker)
+        assert rep["program_count"] == len(bs.buckets)
+        fns = {p["fn"] for p in rep["programs"]}
+        assert all("bucket" in fn or fn for fn in fns)
+        for p in rep["programs"]:
+            assert p["flops"] is not None and p["flops"] > 0
+            assert p["intensity_flops_per_byte"] is not None
+        # and the table renders one line per program
+        text = format_roofline(rep)
+        assert sum(
+            1 for line in text.splitlines()
+            if any(p["fn"] in line for p in rep["programs"])
+        ) >= len(bs.buckets)
