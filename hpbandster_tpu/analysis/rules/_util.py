@@ -4,7 +4,7 @@ module import aliases, and generic node walks."""
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterator, List, Optional, Set
+from typing import Dict, Iterable, Iterator, List, Optional, Set
 
 __all__ = [
     "dotted_name",
@@ -37,9 +37,9 @@ class ImportMap:
     through this table so rules can match on true module paths.
     """
 
-    def __init__(self, tree: ast.Module):
+    def __init__(self, tree: ast.Module, nodes: Optional[Iterable[ast.AST]] = None):
         self.aliases: Dict[str, str] = {}
-        for node in ast.walk(tree):
+        for node in ast.walk(tree) if nodes is None else nodes:
             if isinstance(node, ast.Import):
                 for a in node.names:
                     self.aliases[a.asname or a.name.split(".")[0]] = (
@@ -64,7 +64,7 @@ def import_map_for(module) -> "ImportMap":
     """Per-module ImportMap, built once and memoized on the SourceModule."""
     imports = module.cache.get("import_map")
     if imports is None:
-        imports = ImportMap(module.tree)
+        imports = ImportMap(module.tree, nodes=module.walk())
         module.cache["import_map"] = imports
     return imports
 

@@ -62,9 +62,9 @@ def _is_broad(handler: ast.ExceptHandler, imports: ImportMap) -> bool:
     return any((imports.resolve(t) or "") in _BROAD for t in types)
 
 
-def _handles(handler: ast.ExceptHandler) -> bool:
+def _handles(module: SourceModule, handler: ast.ExceptHandler) -> bool:
     exc_name = handler.name
-    for node in ast.walk(handler):
+    for node in module.subtree(handler):
         if isinstance(node, ast.Raise):
             return True
         if exc_name and isinstance(node, ast.Name) and node.id == exc_name:
@@ -95,7 +95,7 @@ class SwallowedExceptionRule(Rule):
         for node in module.walk():
             if not isinstance(node, ast.ExceptHandler):
                 continue
-            if not _is_broad(node, imports) or _handles(node):
+            if not _is_broad(node, imports) or _handles(module, node):
                 continue
             caught = "bare except" if node.type is None else (
                 f"except {ast.unparse(node.type)}"

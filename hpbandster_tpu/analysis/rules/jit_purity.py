@@ -179,9 +179,12 @@ class JitHostSyncRule(Rule):
         if fn.args.vararg is not None:
             traced.add(fn.args.vararg.arg)
 
+        fn_nodes = tuple(module.subtree(fn))
+
         def refs_traced(node: ast.AST) -> bool:
             return any(
-                isinstance(n, ast.Name) and n.id in traced for n in ast.walk(node)
+                isinstance(n, ast.Name) and n.id in traced
+                for n in module.subtree(node)
             )
 
         def taint_target(tgt: ast.expr) -> None:
@@ -198,7 +201,7 @@ class JitHostSyncRule(Rule):
         # two forward passes: assignments referencing traced names taint
         # their targets (handles use-before-def between helpers once)
         for _ in range(2):
-            for node in ast.walk(fn):
+            for node in fn_nodes:
                 if isinstance(node, ast.Assign) and refs_traced(node.value):
                     for tgt in node.targets:
                         taint_target(tgt)
@@ -217,7 +220,7 @@ class JitHostSyncRule(Rule):
                 )
             )
 
-        for node in ast.walk(fn):
+        for node in fn_nodes:
             if isinstance(node, ast.Call):
                 callee = imports.resolve(node.func)
                 if (

@@ -79,7 +79,10 @@ class LockCoverageRule(Rule):
     def _check_class(
         self, module: SourceModule, imports: ImportMap, cls: ast.ClassDef
     ) -> List[Finding]:
-        locks = self._lock_attrs(imports, cls)
+        # one traversal of the class body feeds every pass below (the rule
+        # used to re-walk the subtree four times per class)
+        cls_nodes = tuple(module.subtree(cls))
+        locks = self._lock_attrs(imports, cls_nodes)
         if not locks:
             return []
 
@@ -90,12 +93,12 @@ class LockCoverageRule(Rule):
 
         init_funcs = {
             fn
-            for fn in ast.walk(cls)
+            for fn in cls_nodes
             if isinstance(fn, ast.FunctionDef) and fn.name in ("__init__", "__new__")
         }
         init_nodes: Set[int] = set()
         for fn in init_funcs:
-            for sub in ast.walk(fn):
+            for sub in module.subtree(fn):
                 init_nodes.add(id(sub))
 
         def visit(node: ast.AST, held: Tuple[str, ...]) -> None:
@@ -118,7 +121,7 @@ class LockCoverageRule(Rule):
         visit(cls, ())
 
         # pass 1: the protected set — stores under a held lock
-        for node in ast.walk(cls):
+        for node in cls_nodes:
             if id(node) in init_nodes:
                 continue
             held = held_at.get(id(node), set())
@@ -146,7 +149,7 @@ class LockCoverageRule(Rule):
         # pass 2: accesses outside every guarding lock
         findings: List[Finding] = []
         seen_lines: Set[Tuple[int, str]] = set()
-        for node in ast.walk(cls):
+        for node in cls_nodes:
             attr = _self_attr(node)
             if attr is None or attr not in protected or id(node) in init_nodes:
                 continue
@@ -170,9 +173,11 @@ class LockCoverageRule(Rule):
             )
         return findings
 
-    def _lock_attrs(self, imports: ImportMap, cls: ast.ClassDef) -> Set[str]:
+    def _lock_attrs(
+        self, imports: ImportMap, cls_nodes: Tuple[ast.AST, ...]
+    ) -> Set[str]:
         locks: Set[str] = set()
-        for node in ast.walk(cls):
+        for node in cls_nodes:
             if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
                 resolved = imports.resolve(node.value.func)
                 if resolved in _LOCK_FACTORIES:

@@ -88,7 +88,7 @@ class PytestMarkerRule(Rule):
             return
         if _has_slow_marker(node.decorator_list):
             return
-        reason = self._slow_reason(node)
+        reason = self._slow_reason(module, node)
         if reason is not None:
             findings.append(
                 self.finding(
@@ -98,8 +98,10 @@ class PytestMarkerRule(Rule):
                 )
             )
 
-    def _slow_reason(self, fn: ast.FunctionDef) -> Optional[str]:
-        for node in ast.walk(fn):
+    def _slow_reason(
+        self, module: SourceModule, fn: ast.FunctionDef
+    ) -> Optional[str]:
+        for node in module.subtree(fn):
             if isinstance(node, ast.Call):
                 callee = dotted_name(node.func) or ""
                 if callee in ("jax.pmap", "pmap"):

@@ -64,7 +64,7 @@ class ObsEmitInJitRule(Rule):
         imports_obs = _module_imports_obs(imports)
         findings: List[Finding] = []
         for fn in traced_functions_for(module):
-            for node in ast.walk(fn):
+            for node in module.subtree(fn):
                 if not isinstance(node, ast.Call):
                     continue
                 if _resolves_to_obs(node.func, imports):

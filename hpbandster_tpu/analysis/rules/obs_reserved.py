@@ -2,16 +2,18 @@
 
 The journal record schema reserves ``event``/``t_wall``/``t_mono`` (the
 serializer's own columns) plus the substrate-stamped ``trace_id`` (trace
-context, ``obs/trace.py``) and ``host``/``pid`` (identity static fields,
+context, ``obs/trace.py``), ``tenant_id`` (tenant context, the serving
+tier's identity stamp) and ``host``/``pid`` (identity static fields,
 ``JsonlJournal(static_fields=...)``). A call site that passes one of
 these to ``emit(...)``/``span(...)`` either collides with the stamp or —
 worse — fabricates it: a hand-written ``trace_id`` breaks the cross-host
-join, a hand-written ``host`` lies about where the record came from.
+join, a hand-written ``tenant_id`` mis-attributes another tenant's work,
+a hand-written ``host`` lies about where the record came from.
 
-The supported patterns are: enter a trace (``use_trace``) and let
-``make_event`` stamp ``trace_id``; configure identity once
-(``obs.configure(identity=...)`` / ``process_identity()``) and let the
-journal stamp ``host``/``pid``.
+The supported patterns are: enter a trace (``use_trace``) / a tenant
+(``use_tenant``) and let ``make_event`` stamp ``trace_id``/``tenant_id``;
+configure identity once (``obs.configure(identity=...)`` /
+``process_identity()``) and let the journal stamp ``host``/``pid``.
 
 Detection mirrors ``obs-emit-in-jit``'s resolution: calls resolving
 through the import map into ``hpbandster_tpu.obs`` (``emit``, ``span``,
@@ -34,7 +36,7 @@ from hpbandster_tpu.analysis.rules.obs_emit import (
 
 #: journal-record keys only the substrate may write
 RESERVED_FIELDS = frozenset(
-    {"event", "t_wall", "t_mono", "host", "pid", "trace_id"}
+    {"event", "t_wall", "t_mono", "host", "pid", "trace_id", "tenant_id"}
 )
 
 _EMITTING_ATTRS = ("emit", "span")
@@ -44,10 +46,10 @@ _EMITTING_ATTRS = ("emit", "span")
 class ObsReservedFieldsRule(Rule):
     name = "obs-reserved-fields"
     description = (
-        "reserved journal field (event/t_wall/t_mono/host/pid/trace_id) "
-        "passed as an ad-hoc emit/span kwarg — these are stamped by the "
-        "substrate (serializer, trace context, identity static fields); "
-        "a call-site copy collides or lies"
+        "reserved journal field (event/t_wall/t_mono/host/pid/trace_id/"
+        "tenant_id) passed as an ad-hoc emit/span kwarg — these are "
+        "stamped by the substrate (serializer, trace/tenant context, "
+        "identity static fields); a call-site copy collides or lies"
     )
 
     def check(self, module: SourceModule) -> List[Finding]:

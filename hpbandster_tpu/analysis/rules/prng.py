@@ -273,7 +273,7 @@ class _FunctionScan:
                     self._fresh(name)  # rebound to something else: new version
 
     def _record_uses(self, expr: ast.expr) -> None:
-        for node in ast.walk(expr):
+        for node in self.module.subtree(expr):
             if not isinstance(node, ast.Call):
                 continue
             callee = self._random_callee(node)
@@ -315,7 +315,7 @@ class _FunctionScan:
         if created == current or current[: len(created)] != created:
             return  # created in this nest (or weirdness): the carry idiom
         innermost = self.loops[-1]
-        for n in ast.walk(innermost):
+        for n in self.module.subtree(innermost):
             if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
                 tgts = n.targets if isinstance(n, ast.Assign) else [n.target]
                 for tgt in tgts:

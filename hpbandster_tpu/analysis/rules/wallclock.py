@@ -30,7 +30,7 @@ import ast
 from typing import List, Set
 
 from hpbandster_tpu.analysis.core import Finding, Rule, SourceModule, register
-from hpbandster_tpu.analysis.rules._util import import_map_for, iter_functions
+from hpbandster_tpu.analysis.rules._util import import_map_for
 
 _WALL_CALLS = {"time.time", "datetime.datetime.now", "datetime.datetime.utcnow"}
 
@@ -43,17 +43,42 @@ def _is_wall_call(node: ast.AST, imports) -> bool:
     )
 
 
-def _wall_names(fn: ast.AST, imports) -> Set[str]:
+def _wall_names(nodes, imports) -> Set[str]:
     """Local names assigned directly from a wall-clock call anywhere in
-    ``fn`` (flow-insensitive on purpose: a name that EVER holds a wall
-    timestamp should never sit in duration arithmetic)."""
+    the node list (flow-insensitive on purpose: a name that EVER holds a
+    wall timestamp should never sit in duration arithmetic)."""
     names: Set[str] = set()
-    for node in ast.walk(fn):
+    for node in nodes:
         if isinstance(node, ast.Assign) and _is_wall_call(node.value, imports):
             for target in node.targets:
                 if isinstance(target, ast.Name):
                     names.add(target.id)
     return names
+
+
+def _outer_functions(tree: ast.AST):
+    """(outermost functions, module-level non-function nodes).
+
+    One pass, no re-walking: each outermost function's subtree is walked
+    exactly once by the caller — the old per-``iter_functions``-entry
+    walk re-traversed every nested closure once per nesting level, which
+    made this rule the scan's hot spot as the tree grew.
+    """
+    outers: List[ast.AST] = []
+    module_nodes: List[ast.AST] = [tree]
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                outers.append(child)
+            else:
+                module_nodes.append(child)
+                visit(child)
+
+    visit(tree)
+    return outers, module_nodes
 
 
 @register
@@ -69,9 +94,8 @@ class WallclockDurationRule(Rule):
             return []
         imports = import_map_for(module)
         findings: List[Finding] = []
-        seen: Set[int] = set()
 
-        def scan(scope: ast.AST, wall_names: Set[str]) -> None:
+        def scan(nodes, wall_names: Set[str]) -> None:
             def is_wall(operand: ast.AST) -> bool:
                 if _is_wall_call(operand, imports):
                     return True
@@ -79,15 +103,12 @@ class WallclockDurationRule(Rule):
                     isinstance(operand, ast.Name) and operand.id in wall_names
                 )
 
-            for node in ast.walk(scope):
+            for node in nodes:
                 if not isinstance(node, ast.BinOp) or not isinstance(
                     node.op, ast.Sub
                 ):
                     continue
-                if id(node) in seen:
-                    continue
                 if is_wall(node.left) or is_wall(node.right):
-                    seen.add(id(node))
                     findings.append(
                         self.finding(
                             module, node,
@@ -99,10 +120,15 @@ class WallclockDurationRule(Rule):
                         )
                     )
 
-        for fn in iter_functions(module.tree):
-            scan(fn, _wall_names(fn, imports))
+        # nested closures share their outermost function's (superset)
+        # wall-name pool — the same verdicts the old outer-first
+        # iter_functions walk produced, at one traversal per subtree
+        outers, module_nodes = _outer_functions(module.tree)
+        for fn in outers:
+            nodes = list(module.subtree(fn))
+            scan(nodes, _wall_names(nodes, imports))
         # module level: direct calls only (module-scope assignments of
         # wall stamps subtracted later are overwhelmingly cross-run
         # timestamps, not durations)
-        scan(module.tree, set())
+        scan(module_nodes, set())
         return findings

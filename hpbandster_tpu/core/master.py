@@ -54,9 +54,17 @@ class Master:
         checkpoint_path: Optional[str] = None,
         checkpoint_interval: float = 30.0,
         collector: Any = None,
+        tenant_id: Optional[str] = None,
     ):
         self.run_id = run_id
         self.config_generator = config_generator
+        #: serving-tier identity (hpbandster_tpu/serve): when set, every
+        #: event this master's loop emits — job lifecycle, bracket audit,
+        #: config_sampled from its iterations — carries ``tenant_id``, and
+        #: every RPC it makes ships the tenant in the ``_obs`` envelope.
+        #: None (the default) changes nothing: single-tenant journals stay
+        #: byte-identical.
+        self.tenant_id = tenant_id
         self.working_directory = working_directory
         self.logger = logger or logging.getLogger("hpbandster_tpu.master")
         self.result_logger = result_logger
@@ -219,7 +227,9 @@ class Master:
         # carry its own job's trace_id.
         loss = job.loss
         run_s = job.mono_duration("started", "finished")
-        with obs.use_trace(getattr(job, "trace", None)):
+        with obs.use_tenant(self.tenant_id), obs.use_trace(
+            getattr(job, "trace", None)
+        ):
             obs.emit(
                 obs.JOB_FAILED if job.exception is not None else obs.JOB_FINISHED,
                 config_id=list(job.id),
@@ -237,7 +247,9 @@ class Master:
             # feeds the obs_snapshot `latency` section: evaluation-time
             # quantiles visible over RPC with no journal on disk
             obs.get_metrics().histogram("master.job_run_s").observe(run_s)
-        with self.thread_cond:
+        # the tenant wrap covers the bracket bookkeeping too: promotion /
+        # audit events emitted by process_results() carry the stamp
+        with obs.use_tenant(self.tenant_id), self.thread_cond:
             self.num_running_jobs -= 1
             if self.result_logger is not None:
                 self.result_logger(job)
@@ -271,13 +283,16 @@ class Master:
         # mint the job's trace identity here — the one id that survives the
         # master -> dispatcher -> worker -> result round-trip (obs/trace.py)
         job.trace = obs.new_trace(self.run_id)
+        job.tenant_id = self.tenant_id
         job.time_it("submitted")
-        with obs.use_trace(job.trace):
+        with obs.use_tenant(self.tenant_id), obs.use_trace(job.trace):
             obs.emit(obs.JOB_SUBMITTED, config_id=list(config_id), budget=budget)
-        with self.thread_cond:
-            self.num_running_jobs += 1
-            self.jobs.append(job)
-        self.executor.submit_job(job)
+            with self.thread_cond:
+                self.num_running_jobs += 1
+                self.jobs.append(job)
+            # submit under the tenant too: an RPC-backed executor ships
+            # the tenant in the _obs envelope of the dispatch itself
+            self.executor.submit_job(job)
 
     def active_iterations(self) -> List[int]:
         return [i for i, it in enumerate(self.iterations) if not it.is_finished]
@@ -341,6 +356,16 @@ class Master:
                     "executor schedule preparation failed; continuing "
                     "with per-shape compilation"
                 )
+        # the whole drive loop runs under the tenant identity: fresh
+        # samples (config_sampled via get_next_run -> add_configuration)
+        # and bracket_created audit records carry the stamp. use_tenant of
+        # None is a passthrough, so the single-tenant path is unchanged.
+        with obs.use_tenant(self.tenant_id):
+            return self._run_loop(n_remaining, iteration_kwargs)
+
+    def _run_loop(
+        self, n_remaining: int, iteration_kwargs: Dict[str, Any]
+    ) -> Result:
         while True:
             with self.thread_cond:
                 # respect the in-flight window (async executors)

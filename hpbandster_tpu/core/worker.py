@@ -245,12 +245,13 @@ class Worker:
             raise RuntimeError("worker is busy")
         self._last_active = time.monotonic()
         self._current_job = tuple(id)
-        # threads do not inherit contextvars: capture the trace the RPC
-        # handler extracted from the _obs envelope and hand it to the
-        # compute thread explicitly
+        # threads do not inherit contextvars: capture the trace AND the
+        # tenant the RPC handler extracted from the _obs envelope and
+        # hand them to the compute thread explicitly
         thread = threading.Thread(
             target=self._run_job,
-            args=(callback_uri, tuple(id), job_kwargs, obs.current_trace()),
+            args=(callback_uri, tuple(id), job_kwargs, obs.current_trace(),
+                  obs.current_tenant()),
             daemon=True,
             name=f"compute-{id}",
         )
@@ -263,8 +264,12 @@ class Worker:
         config_id: Any,
         job_kwargs: Dict[str, Any],
         trace_ctx: Optional[obs.TraceContext] = None,
+        tenant: Optional[str] = None,
     ) -> None:
-        with obs.use_trace(trace_ctx):
+        # under both identities: worker-side journal twins carry the
+        # master's trace_id AND (serving tier) its tenant_id, and the
+        # register_result RPC ships them back in its own envelope
+        with obs.use_tenant(tenant), obs.use_trace(trace_ctx):
             self._emit(
                 obs.JOB_STARTED,
                 config_id=list(config_id), budget=job_kwargs.get("budget"),

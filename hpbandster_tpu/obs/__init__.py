@@ -154,11 +154,15 @@ from hpbandster_tpu.obs.runtime import (  # noqa: F401
     tracked_jit,
 )
 from hpbandster_tpu.obs.trace import (  # noqa: F401
+    DEFAULT_TENANT,
     TraceContext,
+    current_tenant,
     current_trace,
     current_wire,
+    extract_tenant,
     extract_wire,
     new_trace,
+    use_tenant,
     use_trace,
 )
 
@@ -169,6 +173,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_metrics",
     "TraceContext", "new_trace", "current_trace", "use_trace",
     "current_wire", "extract_wire",
+    "DEFAULT_TENANT", "current_tenant", "use_tenant", "extract_tenant",
     "HealthEndpoint", "install_crash_dump",
     "AnomalyDetector", "AnomalyRules", "scan_records",
     "AUDIT_EVENTS", "config_lineage", "emit_bracket_created",

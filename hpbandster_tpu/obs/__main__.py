@@ -4,11 +4,13 @@
   (possibly rotated) journals by wall clock; print per-stage latency
   percentiles, worker utilization, failure tallies, and the merged
   per-trace timelines (queue wait -> dispatch -> compute -> delivery).
-* ``report <journal> [<journal> ...] [--json]`` — the optimizer-decision
-  view (``obs/report.py``): incumbent trajectory, model-vs-random win
-  rate, per-rung promotion regret, bracket utilization, alert digest.
-  Deterministic: two invocations over the same journals are
-  byte-identical.
+* ``report <journal> [<journal> ...] [--json] [--tenant T]`` — the
+  optimizer-decision view (``obs/report.py``): incumbent trajectory,
+  model-vs-random win rate, per-rung promotion regret, bracket
+  utilization, alert digest. Deterministic: two invocations over the
+  same journals are byte-identical. ``--tenant`` replays ONE tenant's
+  slice of a multi-tenant serving journal (records without a
+  ``tenant_id`` belong to ``default``).
 * ``watch <journal> [--interval S] [--ticks N]`` — tail a live journal,
   one status line per tick; runs until ^C unless ``--ticks`` bounds it.
   ``watch --snapshot <uri> [--snapshot <uri> ...]`` polls live
@@ -43,7 +45,7 @@ import time
 from typing import Any, List, Optional, Tuple
 
 from hpbandster_tpu.obs.journal import journal_paths
-from hpbandster_tpu.obs.report import build_report, format_report
+from hpbandster_tpu.obs.report import build_report, filter_tenant, format_report
 from hpbandster_tpu.obs.summarize import (
     format_summary,
     read_merged_ex,
@@ -171,6 +173,7 @@ def run_top(
     ticks: Optional[int] = None,
     clear: bool = True,
     stream: Optional[Any] = None,
+    tenant: Optional[str] = None,
 ) -> int:
     """The ``top`` subcommand body (separated so tests drive it): a
     refreshing fleet table from live endpoint polling (``--snapshot``,
@@ -222,7 +225,8 @@ def run_top(
         print(f"hpbandster fleet top — {stamp} ({source})  [q quits]",
               file=out)
         if sample is not None:
-            print(format_fleet_table(sample), file=out, flush=True)
+            print(format_fleet_table(sample, tenant=tenant), file=out,
+                  flush=True)
         else:
             print("(no fleet samples yet)", file=out, flush=True)
         tick += 1
@@ -264,6 +268,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--json", action="store_true", dest="as_json",
         help="emit the report as JSON instead of text",
     )
+    p_rep.add_argument(
+        "--tenant", metavar="TENANT", default=None,
+        help="report one tenant's slice of a multi-tenant journal "
+        "(records without tenant_id belong to 'default')",
+    )
     p_watch = sub.add_parser(
         "watch", help="tail a live journal (or poll a health RPC), "
         "one status line per tick"
@@ -284,6 +293,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_watch.add_argument(
         "--ticks", type=int, default=None,
         help="stop after N ticks (default: run until ^C)",
+    )
+    p_watch.add_argument(
+        "--tenant", metavar="TENANT", default=None,
+        help="with --snapshot: show this tenant's serving counters on "
+        "each row instead of the tenant census",
     )
     p_top = sub.add_parser(
         "top",
@@ -311,6 +325,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_top.add_argument(
         "--no-clear", action="store_true", dest="no_clear",
         help="append frames instead of clearing the screen (pipelines/tests)",
+    )
+    p_top.add_argument(
+        "--tenant", metavar="TENANT", default=None,
+        help="narrow the table to endpoints serving this tenant; the "
+        "tenants column then shows the tenant's configs_done",
     )
     p_exp = sub.add_parser(
         "export",
@@ -340,7 +359,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.command == "top":
         return run_top(
             uris=args.snapshot, series=args.series, interval=args.interval,
-            ticks=args.ticks, clear=not args.no_clear,
+            ticks=args.ticks, clear=not args.no_clear, tenant=args.tenant,
         )
 
     if args.command == "export":
@@ -359,11 +378,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                 )
                 return 2
             return watch_snapshot(
-                args.snapshot, interval=args.interval, ticks=args.ticks
+                args.snapshot, interval=args.interval, ticks=args.ticks,
+                tenant=args.tenant,
             )
         if args.journal is None:
             print(
                 "error: watch needs a journal path or --snapshot URI",
+                file=sys.stderr,
+            )
+            return 2
+        if args.tenant is not None:
+            # refusing beats silently watching every tenant's records
+            print(
+                "error: watch --tenant requires --snapshot (journal mode "
+                "has no tenant filter; use 'report --tenant' for a "
+                "per-tenant journal replay)",
                 file=sys.stderr,
             )
             return 2
@@ -373,6 +402,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if records is None:
         return 2
     if args.command == "report":
+        if args.tenant is not None:
+            records = filter_tenant(records, args.tenant)
         rep = build_report(records)
         if args.as_json:
             print(json.dumps(rep, indent=1, sort_keys=True))

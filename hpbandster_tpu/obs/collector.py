@@ -69,6 +69,7 @@ __all__ = [
     "format_fleet_table",
     "read_series",
     "read_series_tail",
+    "tenant_counters",
 ]
 
 logger = E.logger
@@ -121,6 +122,23 @@ def _num(x: Any) -> Optional[float]:
     return float(x) if math.isfinite(x) else None
 
 
+def tenant_counters(
+    counters: Mapping[str, Any], field: str = "configs_done"
+) -> Dict[str, Any]:
+    """``{tenant: value}`` for every ``serve.tenant.<t>.<field>`` counter
+    — the ONE parser of the serving tier's per-tenant metric names
+    (serve/pool.py emits them; this module and summarize's watch line
+    both read them)."""
+    prefix, suffix = "serve.tenant.", f".{field}"
+    out: Dict[str, Any] = {}
+    for name, value in counters.items():
+        if name.startswith(prefix) and name.endswith(suffix):
+            tenant = name[len(prefix):-len(suffix)]
+            if tenant:
+                out[tenant] = value
+    return out
+
+
 def _endpoint_row(snap: Dict[str, Any]) -> Dict[str, Any]:
     """Distill one ``obs_snapshot`` into the per-endpoint series row: the
     handful of fields fleet aggregation and ``top`` actually read."""
@@ -139,6 +157,14 @@ def _endpoint_row(snap: Dict[str, Any]) -> Dict[str, Any]:
                 if d.get(k) is not None
             }
     alerts = snap.get("alerts") or {}
+    # serving-tier census (serve/pool.py): per-tenant configs_done
+    # counters fold into one {tenant: done} map per endpoint — what the
+    # fleet fairness ratio and the `top` tenant column aggregate
+    tenants: Dict[str, float] = {}
+    for tenant, value in tenant_counters(counters).items():
+        v = _num(value)
+        if v is not None:
+            tenants[tenant] = v
     return {
         "component": snap.get("component"),
         "uptime_s": _num(snap.get("uptime_s")),
@@ -152,6 +178,7 @@ def _endpoint_row(snap: Dict[str, Any]) -> Dict[str, Any]:
         "top_recompilers": _top_recompilers(compile_led),
         "devices": dev_rows,
         "alerts_total": _num(alerts.get("total")),
+        "tenants": tenants,
     }
 
 
@@ -229,6 +256,34 @@ def derive_fleet(
             if r.get("ok") and r.get("component") == "worker"
         ))
 
+    # multi-tenant fairness (serve/pool.py): cumulative configs_done per
+    # tenant summed over every endpoint; the max/min ratio is the fleet's
+    # one-number fairness gauge (1.0 = perfectly even service; None with
+    # <2 tenants or before the slowest tenant's first delivery — a ratio
+    # over a zero denominator would read as infinite unfairness during
+    # warmup, which is noise, not signal)
+    tenant_done: Dict[str, float] = {}
+    for r in rows.values():
+        for tenant, done in (r.get("tenants") or {}).items():
+            v = _num(done)
+            if v is not None:
+                tenant_done[tenant] = tenant_done.get(tenant, 0.0) + v
+    ratio = None
+    if len(tenant_done) >= 2 and min(tenant_done.values()) > 0:
+        ratio = round(
+            max(tenant_done.values()) / min(tenant_done.values()), 4
+        )
+    # the ratio's None-during-warmup blind spot must not hide PERMANENT
+    # starvation: tenants stuck at zero while others progress get their
+    # own count, so an alert can fire on exactly the case the ratio
+    # cannot express
+    starved = None
+    if tenant_done:
+        starved = (
+            sum(1 for v in tenant_done.values() if v == 0)
+            if any(v > 0 for v in tenant_done.values()) else 0
+        )
+
     return {
         "endpoints": len(rows),
         "ok": ok,
@@ -243,6 +298,9 @@ def derive_fleet(
             round(utilization, 4) if utilization is not None else None
         ),
         "device_mem_skew": round(skew, 4) if skew is not None else None,
+        "tenants": len(tenant_done) if tenant_done else None,
+        "tenants_starved": starved,
+        "tenant_throughput_ratio": ratio,
     }
 
 
@@ -538,6 +596,9 @@ class FleetCollector:
             ("worker_churn_per_min", "fleet.worker_churn_per_min"),
             ("queue_depth_trend_per_min", "fleet.queue_depth_trend_per_min"),
             ("compile_rate_per_min", "fleet.compile_rate_per_min"),
+            ("tenants", "fleet.tenants"),
+            ("tenants_starved", "fleet.tenants_starved"),
+            ("tenant_throughput_ratio", "fleet.tenant_throughput_ratio"),
         ):
             v = _num(fleet.get(field))
             if v is not None:
@@ -715,8 +776,15 @@ def _fmt(v: Any, nd: int = 0, dash: str = "-") -> str:
     return str(v)
 
 
-def format_fleet_table(sample: Dict[str, Any]) -> str:
-    """Render one ``fleet_sample`` as the ``obs top`` fleet table."""
+def format_fleet_table(
+    sample: Dict[str, Any], tenant: Optional[str] = None
+) -> str:
+    """Render one ``fleet_sample`` as the ``obs top`` fleet table.
+
+    ``tenant`` narrows the view to endpoints serving that tenant; the
+    per-endpoint ``tenants`` column then shows the tenant's own
+    ``configs_done`` instead of the serving tenant count.
+    """
     fleet = sample.get("fleet") or {}
     lines = [
         "fleet: endpoints {}/{} ok ({} stale)  workers={}  queue={}  "
@@ -733,16 +801,27 @@ def format_fleet_table(sample: Dict[str, Any]) -> str:
             _fmt(fleet.get("queue_depth_trend_per_min"), 2),
             _fmt(fleet.get("compile_rate_per_min"), 2),
         ),
-        "",
     ]
+    if fleet.get("tenants") is not None or tenant is not None:
+        lines.append(
+            "       tenants={}  throughput_ratio={}{}".format(
+                _fmt(fleet.get("tenants")),
+                _fmt(fleet.get("tenant_throughput_ratio"), 2),
+                f"  [filter: tenant={tenant}]" if tenant else "",
+            )
+        )
+    lines.append("")
     header = (
         f"{'endpoint':<18} {'comp':<10} {'ok':<3} {'up_s':>8} "
-        f"{'stale_s':>8} {'inflight':<14} {'alerts':>6} {'compiles':>8}  "
-        f"top_recompilers"
+        f"{'stale_s':>8} {'inflight':<14} {'alerts':>6} {'compiles':>8} "
+        f"{'tenants':>8}  top_recompilers"
     )
     lines.append(header)
     lines.append("-" * len(header))
     for name, row in sorted((sample.get("endpoints") or {}).items()):
+        tenants = row.get("tenants") or {}
+        if tenant is not None and tenant not in tenants:
+            continue
         in_flight = row.get("in_flight")
         if isinstance(in_flight, dict):
             in_flight = ",".join(
@@ -753,6 +832,10 @@ def format_fleet_table(sample: Dict[str, Any]) -> str:
             f"{r['fn']}x{r['compiles']}"
             for r in (row.get("top_recompilers") or [])
         )
+        tenant_cell = (
+            _fmt(tenants.get(tenant)) if tenant is not None
+            else (_fmt(len(tenants)) if tenants else "-")
+        )
         lines.append(
             f"{name[:18]:<18} {str(row.get('component') or '?')[:10]:<10} "
             f"{'y' if row.get('ok') else 'N':<3} "
@@ -760,6 +843,7 @@ def format_fleet_table(sample: Dict[str, Any]) -> str:
             f"{_fmt(row.get('stale_s'), 1):>8} "
             f"{str(in_flight if in_flight is not None else '-')[:14]:<14} "
             f"{_fmt(row.get('alerts_total')):>6} "
-            f"{_fmt(row.get('compiles')):>8}  {recomp}"
+            f"{_fmt(row.get('compiles')):>8} "
+            f"{tenant_cell:>8}  {recomp}"
         )
     return "\n".join(lines)

@@ -31,7 +31,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
-from hpbandster_tpu.obs.trace import current_trace
+from hpbandster_tpu.obs.trace import current_tenant, current_trace
 
 __all__ = [
     "Event",
@@ -124,14 +124,20 @@ Sink = Callable[[Event], None]
 
 
 def make_event(name: str, fields: Dict[str, Any]) -> Event:
-    """Construct one stamped :class:`Event`: wall + monotonic clocks, and
-    the current trace's ``trace_id`` (see :mod:`~hpbandster_tpu.obs.trace`)
-    folded into the fields. The one place trace stamping happens — call
-    sites never pass ``trace_id`` by hand (``obs-reserved-fields`` rule).
+    """Construct one stamped :class:`Event`: wall + monotonic clocks, the
+    current trace's ``trace_id`` and the current tenant's ``tenant_id``
+    (see :mod:`~hpbandster_tpu.obs.trace`) folded into the fields. The one
+    place trace/tenant stamping happens — call sites never pass
+    ``trace_id``/``tenant_id`` by hand (``obs-reserved-fields`` rule).
+    With no tenant context the field is absent entirely, so single-tenant
+    journals stay byte-compatible (readers default it to ``"default"``).
     """
     tc = current_trace()
     if tc is not None and "trace_id" not in fields:
         fields = dict(fields, trace_id=tc.trace_id)
+    tenant = current_tenant()
+    if tenant is not None and "tenant_id" not in fields:
+        fields = dict(fields, tenant_id=tenant)
     return Event(name, time.time(), time.monotonic(), fields)
 
 

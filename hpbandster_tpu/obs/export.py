@@ -90,6 +90,16 @@ _LABEL_RULES: Tuple[Tuple[re.Pattern, str, str], ...] = (
     (re.compile(
         r"^dispatcher\.worker_last_seen_age_s\.(?P<label>.+)$", re.DOTALL),
      "dispatcher_worker_last_seen_age_s", "worker"),
+    # per-tenant serving-tier families (serve/pool.py, serve/frontend.py):
+    # serve.tenant.<tenant>.configs_done -> serve_tenant_configs_done
+    # {tenant="<tenant>"}. The greedy label group + the dot-free field
+    # group means a tenant id containing dots keeps them in the label
+    # (the LAST dot separates the field); any byte is legal in the label
+    # value via the exposition escaping.
+    (re.compile(
+        r"^serve\.tenant\.(?P<label>.+)\.(?P<field>[a-zA-Z0-9_]+)$",
+        re.DOTALL),
+     "serve_tenant_{field}", "tenant"),
 )
 
 

@@ -44,8 +44,29 @@ from hpbandster_tpu.obs import events as E
 from hpbandster_tpu.obs.anomaly import scan_records
 from hpbandster_tpu.obs.audit import config_key, config_lineage
 from hpbandster_tpu.obs.runtime import compile_stats_from_records
+from hpbandster_tpu.obs.trace import DEFAULT_TENANT
 
-__all__ = ["build_report", "format_report"]
+__all__ = ["build_report", "format_report", "filter_tenant"]
+
+
+def filter_tenant(
+    records: List[Dict[str, Any]], tenant: str
+) -> List[Dict[str, Any]]:
+    """One tenant's slice of a merged multi-tenant journal.
+
+    A record without a ``tenant_id`` belongs to :data:`DEFAULT_TENANT` —
+    that is the byte-compat contract (``obs/trace.py``): pre-serving
+    journals, and the non-tenant infrastructure records of a serving
+    process (collector samples, compile events from shared programs),
+    all read as the default tenant. ``report --tenant acme`` over a
+    single-tenant journal therefore returns nothing for ``acme`` and
+    everything for ``default``.
+    """
+    tenant = str(tenant)
+    return [
+        r for r in records
+        if str(r.get("tenant_id", DEFAULT_TENANT)) == tenant
+    ]
 
 
 def _fmt(v: Any) -> str:

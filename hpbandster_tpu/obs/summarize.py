@@ -585,7 +585,28 @@ def _snapshot_runtime_part(snap: Dict[str, Any]) -> str:
     return (" runtime: " + " ".join(parts)) if parts else ""
 
 
-def _snapshot_status_line(snap: Dict[str, Any]) -> str:
+def _snapshot_tenant_part(
+    snap: Dict[str, Any], tenant: Optional[str] = None
+) -> str:
+    """The serving-tier slice of one watch line: per-tenant configs_done
+    counters (serve/pool.py). No tenants, no part — single-tenant lines
+    stay exactly as they were."""
+    from hpbandster_tpu.obs.collector import tenant_counters
+
+    counters = (snap.get("metrics") or {}).get("counters") or {}
+    done = tenant_counters(counters)
+    if tenant is not None:
+        return f" tenant[{tenant}]: configs_done={done.get(tenant, 0)}"
+    if not done:
+        return ""
+    return f" tenants={len(done)}(" + ",".join(
+        f"{t}:{v}" for t, v in sorted(done.items())[:4]
+    ) + (",..." if len(done) > 4 else "") + ")"
+
+
+def _snapshot_status_line(
+    snap: Dict[str, Any], tenant: Optional[str] = None
+) -> str:
     """One endpoint's watch line body from its ``obs_snapshot``."""
     up = snap.get("uptime_s")
     counters = (snap.get("metrics") or {}).get("counters") or {}
@@ -604,6 +625,7 @@ def _snapshot_status_line(snap: Dict[str, Any]) -> str:
         f"counters={sum(counters.values())} "
         f"alerts={alerts.get('total', 0)}"
         + (f" latency: {lat_part}" if lat_part else "")
+        + _snapshot_tenant_part(snap, tenant)
         + _snapshot_runtime_part(snap)
     )
 
@@ -613,6 +635,7 @@ def watch_snapshot(
     interval: float = 2.0,
     ticks: Optional[int] = None,
     stream: Optional[TextIO] = None,
+    tenant: Optional[str] = None,
 ) -> int:
     """Poll one or many live processes' ``obs_snapshot`` health RPCs —
     latency without a journal on disk.
@@ -645,7 +668,7 @@ def watch_snapshot(
             st = states[name]
             snap = snaps.get(name)
             if st["ok"] and isinstance(snap, dict):
-                status = _snapshot_status_line(snap)
+                status = _snapshot_status_line(snap, tenant)
             else:
                 err = (st.get("error") or "?").split(":", 1)[0]
                 stale_s = st.get("stale_s")

@@ -23,6 +23,16 @@ Plumbing rules:
   thread (``Worker._rpc_start_computation`` -> compute thread) must
   capture :func:`current_trace` and re-enter it with :func:`use_trace`.
 
+Tenant identity (the serving tier, ``hpbandster_tpu/serve``) follows the
+same pattern in a SECOND ContextVar: :func:`use_tenant` makes a tenant id
+current, :func:`make_event` stamps it as ``tenant_id`` on every event, and
+:func:`current_wire` carries it in the same ``_obs`` envelope so the
+dispatcher/worker side of a multi-tenant job journals under the right
+tenant. No tenant context means no field anywhere — a single-tenant
+journal stays byte-identical to the pre-serving format, and readers treat
+a missing ``tenant_id`` as the ``"default"`` tenant (:data:`DEFAULT_TENANT`).
+
+
 Stdlib-only, like the rest of ``obs``: importing this module pulls in no
 jax/numpy and a no-trace :func:`current_wire` is one ContextVar read.
 """
@@ -38,17 +48,25 @@ from typing import Any, Dict, Iterator, Optional
 __all__ = [
     "TraceContext",
     "WIRE_FIELD",
+    "DEFAULT_TENANT",
     "new_trace",
     "current_trace",
     "set_trace",
     "reset_trace",
     "use_trace",
+    "current_tenant",
+    "use_tenant",
     "current_wire",
     "extract_wire",
+    "extract_tenant",
 ]
 
 #: the envelope key trace context travels under in RPC messages
 WIRE_FIELD = "_obs"
+
+#: what a missing ``tenant_id`` means to every reader (journal filters,
+#: report --tenant): the pre-serving single-tenant world IS this tenant
+DEFAULT_TENANT = "default"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -98,15 +116,51 @@ def use_trace(ctx: Optional[TraceContext]) -> Iterator[Optional[TraceContext]]:
         _CURRENT.reset(token)
 
 
+# ----------------------------------------------------------------- tenant
+_TENANT: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "hpbandster_tpu_obs_tenant", default=None
+)
+
+
+def current_tenant() -> Optional[str]:
+    """The tenant active in this thread/context, or None (single-tenant)."""
+    return _TENANT.get()
+
+
+@contextlib.contextmanager
+def use_tenant(tenant: Optional[str]) -> Iterator[Optional[str]]:
+    """Run the body under a tenant identity; events emitted inside carry
+    ``tenant_id`` and outgoing RPC envelopes carry ``tenant``.
+    ``use_tenant(None)`` is a no-op passthrough, exactly like
+    :func:`use_trace` — single-tenant call sites never branch."""
+    if tenant is None:
+        yield None
+        return
+    token = _TENANT.set(str(tenant))
+    try:
+        yield tenant
+    finally:
+        _TENANT.reset(token)
+
+
 # ------------------------------------------------------------------- wire
 def current_wire() -> Optional[Dict[str, Any]]:
     """The ``_obs`` envelope for an outgoing RPC: the current trace with
-    its hop count advanced, or None when no trace is active (the common
-    case — one ContextVar read, no allocation)."""
+    its hop count advanced (plus the current tenant when one is active),
+    or None when neither is set (the common case — two ContextVar reads,
+    no allocation)."""
     ctx = _CURRENT.get()
-    if ctx is None:
+    tenant = _TENANT.get()
+    if ctx is None and tenant is None:
         return None
-    return {"run_id": ctx.run_id, "trace_id": ctx.trace_id, "hop": ctx.hop + 1}
+    wire: Dict[str, Any] = {}
+    if ctx is not None:
+        wire.update(
+            run_id=ctx.run_id, trace_id=ctx.trace_id, hop=ctx.hop + 1
+        )
+    if tenant is not None:
+        wire["tenant"] = tenant
+    return wire
 
 
 def extract_wire(wire: Any) -> Optional[TraceContext]:
@@ -126,3 +180,15 @@ def extract_wire(wire: Any) -> Optional[TraceContext]:
         trace_id=trace_id,
         hop=hop if isinstance(hop, int) and hop >= 0 else 0,
     )
+
+
+def extract_tenant(wire: Any) -> Optional[str]:
+    """The tenant id of an incoming ``_obs`` envelope, or None.
+
+    Same tolerance contract as :func:`extract_wire`: a missing, malformed,
+    or tenant-less envelope (every pre-serving peer) is simply no tenant.
+    """
+    if not isinstance(wire, dict):
+        return None
+    tenant = wire.get("tenant")
+    return tenant if isinstance(tenant, str) and tenant else None

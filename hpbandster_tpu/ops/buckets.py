@@ -53,6 +53,7 @@ __all__ = [
     "BucketSet",
     "build_bucket_set",
     "fused_sh_bracket_bucketed",
+    "fused_sh_bracket_bucketed_packed",
     "make_bucketed_bracket_fn",
     "precompile_buckets",
     "slice_member_stages",
@@ -257,6 +258,42 @@ def fused_sh_bracket_bucketed(
         cur_vecs = cur_vecs[sel]
         cur_idx = cur_idx[sel]
     return out
+
+
+def fused_sh_bracket_bucketed_packed(
+    eval_fn: Callable,
+    vectors,
+    counts,
+    bucket: BucketPlan,
+):
+    """A LANE-PACKED stack of bucketed brackets, traceable under ``jit``.
+
+    ``vectors`` is ``f32[P, widths[0], d]`` and ``counts`` ``i32[P, depth]``
+    — ``P`` independent member brackets of the SAME bucket, one per lane
+    (the serving tier's cross-tenant megabatch, ``serve/megabatch.py``).
+    Each lane runs :func:`fused_sh_bracket_bucketed` under ``vmap``;
+    brackets are independent SH ladders, so lanes never interact and each
+    lane's promotions are BIT-IDENTICAL to dispatching that bracket alone
+    (pinned by ``tests/test_serve.py``). Returns the packed per-lane
+    ``(i32[P, sum(widths)], f32[P, sum(widths)])`` pair — the same
+    flat-concatenated layout the solo ``_BucketRunner`` ships, with a
+    leading lane axis.
+
+    A lane whose counts are all zero is pure padding: every stage carries
+    the identity slice and its rows are evaluated (bounded waste, exactly
+    the bucket-padding trade) but never reported to anyone.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def one_lane(vecs, cnts):
+        stages = fused_sh_bracket_bucketed(eval_fn, vecs, cnts, bucket)
+        return (
+            jnp.concatenate([s[0] for s in stages]),
+            jnp.concatenate([s[1] for s in stages]),
+        )
+
+    return jax.vmap(one_lane)(vectors, jnp.asarray(counts, jnp.int32))
 
 
 def slice_member_stages(

@@ -345,10 +345,13 @@ class Dispatcher:
             job.worker_name = worker.name
             queue_wait = job.mono_duration("submitted", "started")
             try:
-                # under the job's trace: the RPC proxy injects the _obs
-                # envelope, so the worker's half of the timeline carries
-                # the same trace_id
-                with obs.use_trace(getattr(job, "trace", None)):
+                # under the job's trace AND tenant: the RPC proxy injects
+                # the _obs envelope, so the worker's half of the timeline
+                # carries the same trace_id (and, in the serving tier,
+                # journals under the right tenant)
+                with obs.use_tenant(
+                    getattr(job, "tenant_id", None)
+                ), obs.use_trace(getattr(job, "trace", None)):
                     t0 = time.monotonic()
                     worker.proxy.call(
                         "start_computation",
@@ -397,12 +400,15 @@ class Dispatcher:
             # post-mortems instead of losing data silently. Outside the
             # lock: sinks do I/O, and a journal write must not stall the
             # job-runner loop on self._cond. The delivering worker's trace
-            # (the _obs envelope on this very RPC) is retained with it, so
-            # the dead letter joins back onto the merged timeline.
+            # and tenant (the _obs envelope on this very RPC) are retained
+            # with it, so the dead letter joins back onto the merged
+            # timeline — and a multi-tenant post-mortem can attribute the
+            # orphaned payload to the sweep that paid for it.
             tc = obs.current_trace()
             self.dead_letters.append({
                 "config_id": list(cid), "result": result,
                 "trace_id": tc.trace_id if tc is not None else None,
+                "tenant_id": obs.current_tenant() or obs.DEFAULT_TENANT,
             })
             obs.get_metrics().counter("dispatcher.unknown_results").inc()
             obs.emit(obs.UNKNOWN_RESULT, config_id=list(cid))

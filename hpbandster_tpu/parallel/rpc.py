@@ -8,11 +8,13 @@ Connection-per-call keeps liveness detection trivial (a vanished peer is a
 on — the same failure surface Pyro4's ``CommunicationError`` gave the
 reference.
 
-Trace context (``hpbandster_tpu.obs.trace``) rides every call as an
-optional ``_obs`` field beside ``method``/``params``: the proxy injects
-the caller's current trace, the server runs the handler under it. Peers
-that predate the field ignore it (``msg.get``-based parsing), so the wire
-format stays backward compatible in both directions.
+Trace and tenant context (``hpbandster_tpu.obs.trace``) ride every call
+as an optional ``_obs`` field beside ``method``/``params``: the proxy
+injects the caller's current trace (and, in the serving tier, the current
+tenant id), the server runs the handler under them. Peers that predate
+the field — or the ``tenant`` key inside it — ignore it
+(``.get``-based parsing), so the wire format stays backward compatible
+in both directions.
 """
 
 from __future__ import annotations
@@ -26,7 +28,14 @@ import traceback
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from hpbandster_tpu.obs import get_metrics
-from hpbandster_tpu.obs.trace import WIRE_FIELD, current_wire, extract_wire, use_trace
+from hpbandster_tpu.obs.trace import (
+    WIRE_FIELD,
+    current_wire,
+    extract_tenant,
+    extract_wire,
+    use_tenant,
+    use_trace,
+)
 
 __all__ = ["RPCServer", "RPCProxy", "RPCError", "CommunicationError", "parse_uri", "format_uri"]
 
@@ -112,10 +121,14 @@ class _Handler(socketserver.BaseRequestHandler):
                 reply = {"error": f"unknown method {method!r}"}
             else:
                 try:
-                    # run the handler under the caller's trace context (the
-                    # optional _obs envelope beside method/params); a missing
-                    # or malformed envelope is simply no trace
-                    with use_trace(extract_wire(msg.get(WIRE_FIELD))):
+                    # run the handler under the caller's trace AND tenant
+                    # context (the optional _obs envelope beside
+                    # method/params); a missing or malformed envelope is
+                    # simply no trace / no tenant
+                    wire = msg.get(WIRE_FIELD)
+                    with use_trace(extract_wire(wire)), use_tenant(
+                        extract_tenant(wire)
+                    ):
                         reply = {"result": fn(**params)}
                 except Exception:
                     _count("rpc.server_handler_errors")

@@ -1,0 +1,71 @@
+"""``hpbandster_tpu.serve`` — sweep-as-a-service: the multi-tenant tier.
+
+One accelerator pool, N tenants submitting independent sweeps (the
+ROADMAP's "millions of users means many concurrent sweeps sharing one
+fleet, not one giant sweep"). The pieces, bottom-up:
+
+* :mod:`~hpbandster_tpu.serve.scheduler` — admission control
+  (reject-with-reason quotas) + weighted deficit-fair scheduling across
+  tenants over a configs x budget cost currency;
+* :mod:`~hpbandster_tpu.serve.megabatch` — cross-tenant megabatching:
+  bucket-compatible brackets from different tenants lane-pack into ONE
+  ``fused_sh_bracket_bucketed_packed`` dispatch (``ops/buckets.py``),
+  results demuxed back per tenant, bit-identical to solo dispatch;
+* :mod:`~hpbandster_tpu.serve.pool` — :class:`ServePool`: per-tenant
+  executor facades feeding fair-scheduled, megabatched rounds against
+  one shared backend;
+* :mod:`~hpbandster_tpu.serve.session` — sweep specs, per-tenant
+  sessions with WARM MODELS (a returning tenant's KDE resumes from its
+  previous Result via ``core/warmstart.py``), and the per-sweep
+  :class:`TenantMaster` driver;
+* :mod:`~hpbandster_tpu.serve.frontend` — :class:`ServeFrontend`: the
+  tenant-facing RPC API (``submit_sweep`` / ``sweep_status`` /
+  ``sweep_result`` / ``tenant_quota``) on the repo's stdlib transport,
+  health-endpoint mounted like every fleet process.
+
+Tenant identity is a context stamp (``obs.use_tenant``): every journal
+record a tenant's sweep produces carries ``tenant_id``, per-tenant
+counters flow to Prometheus with a ``tenant=`` label, and single-tenant
+journals stay byte-identical (no context, no field). See
+docs/serving.md.
+"""
+
+from hpbandster_tpu.serve.frontend import ServeFrontend  # noqa: F401
+from hpbandster_tpu.serve.megabatch import (  # noqa: F401
+    MegaRunner,
+    PackEntry,
+    make_mega_runner,
+    pack_members,
+)
+from hpbandster_tpu.serve.pool import ServePool  # noqa: F401
+from hpbandster_tpu.serve.scheduler import (  # noqa: F401
+    AdmissionController,
+    AdmissionDecision,
+    DeficitFairScheduler,
+    TenantQuota,
+    work_cost,
+)
+from hpbandster_tpu.serve.session import (  # noqa: F401
+    SweepSpec,
+    TenantMaster,
+    TenantSession,
+    TenantStore,
+)
+
+__all__ = [
+    "ServeFrontend",
+    "ServePool",
+    "SweepSpec",
+    "TenantMaster",
+    "TenantSession",
+    "TenantStore",
+    "TenantQuota",
+    "AdmissionController",
+    "AdmissionDecision",
+    "DeficitFairScheduler",
+    "MegaRunner",
+    "PackEntry",
+    "make_mega_runner",
+    "pack_members",
+    "work_cost",
+]

@@ -1,0 +1,682 @@
+"""ServePool — N tenant sweeps sharing one accelerator backend.
+
+The single-sweep engine assumes it owns its executor; the serving tier
+inverts that. Each tenant's optimizer (an ordinary ``Master`` subclass
+with ``tenant_id=`` set) drives a :class:`_TenantExecutor` *facade* that
+implements the executor seam — buffer jobs, ``flush()`` when the master
+drains — but the actual device work funnels into one shared
+:class:`ServePool`:
+
+1. a flush turns the tenant's buffered jobs into *work items*: complete
+   stage-0 bracket waves (bucket-covered, fusable) or budget-grouped
+   stage batches, each stamped with its configs x budget **cost**;
+2. items queue per tenant; the :class:`~hpbandster_tpu.serve.scheduler.
+   DeficitFairScheduler` decides each round which items dispatch now, so
+   a whale tenant cannot starve the pool;
+3. selected bracket items that share a bucket pack into ONE
+   ``megabatch_bracket`` dispatch (``serve/megabatch.py``) — cross-tenant
+   megabatching — while lone brackets ride the solo bucket program and
+   stage batches group by budget across tenants;
+4. results demux back to each tenant's facade, which delivers them on
+   the tenant's own flush thread (the masters' lock discipline never
+   crosses tenants).
+
+Leadership protocol: flushing tenant threads block on the pool condition
+until their items are done; whenever no round is running, one waiting
+thread elects itself leader, runs one scheduler round (device work
+outside the lock), marks results, and notifies. Deferred tenants simply
+keep waiting — their deficit grows every round, so DRR guarantees
+progress. A tenant's results are delivered only from its own thread,
+which is already inside its master's re-entrant condition (the exact
+contract ``BatchedExecutor.flush`` established).
+
+Per-tenant telemetry rides the shared registry under
+``serve.tenant.<tenant>.*`` (Prometheus-labeled by ``obs/export.py``) and
+every event a tenant's master emits carries ``tenant_id`` via the
+context stamp — the pool itself stamps nothing by hand.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hpbandster_tpu import obs
+from hpbandster_tpu.core.job import Job
+from hpbandster_tpu.serve.megabatch import PackEntry, make_mega_runner
+from hpbandster_tpu.serve.scheduler import (
+    AdmissionController,
+    DeficitFairScheduler,
+    work_cost,
+)
+from hpbandster_tpu.space import ConfigurationSpace
+
+__all__ = ["ServePool"]
+
+
+class _WorkItem:
+    """One schedulable unit: a fusable bracket wave or a stage batch."""
+
+    __slots__ = (
+        "kind", "tenant", "jobs", "cost", "info", "vectors", "bucket",
+        "plan", "entry", "budget", "done", "error", "result",
+        "enqueue_mono",
+    )
+
+    def __init__(self, kind: str, tenant: str, jobs: List[Job], cost: float):
+        self.kind = kind  # "bracket" | "stage"
+        self.tenant = tenant
+        self.jobs = jobs
+        self.cost = float(cost)
+        self.info: Optional[Dict[str, Any]] = None
+        self.vectors: Optional[np.ndarray] = None
+        #: the BucketPlan VALUE this bracket was placed in — captured at
+        #: build time so a concurrent bucket-set rebuild (another tenant
+        #: announcing new shapes) can never re-index an in-flight item
+        self.bucket = None
+        self.plan = None
+        self.entry = 0
+        self.budget: Optional[float] = None
+        self.done = False
+        self.error: Optional[str] = None
+        #: bracket: true-shape [(idx, losses), ...]; stage: f32[n] losses
+        self.result: Any = None
+        self.enqueue_mono = 0.0
+
+
+class _TenantExecutor:
+    """The executor seam one tenant's master drives; routes to the pool."""
+
+    unbounded_queue = True
+    prefers_batched_sampling = True
+    #: one bracket at a time per tenant: each bracket's samples see all of
+    #: that tenant's earlier results (the batched executor's policy);
+    #: cross-tenant overlap comes from the POOL, not from stale models
+    preferred_parallel_brackets = 1
+
+    def __init__(self, pool: "ServePool", tenant_id: str):
+        self.pool = pool
+        self.tenant_id = str(tenant_id)
+        self.buffer: List[Job] = []
+        self._new_result_callback: Optional[Callable[..., None]] = None
+        self.total_evaluated = 0
+        #: (config_id, budget) -> loss precomputed by a fused bracket
+        self._fused_cache: Dict[Tuple[Any, float], float] = {}
+
+    # ---------------------------------------------------------- executor seam
+    def start(self, new_result_callback, new_worker_callback) -> None:
+        self._new_result_callback = new_result_callback
+        new_worker_callback(self.number_of_workers())
+
+    def number_of_workers(self) -> int:
+        return max(int(getattr(self.pool.backend, "parallelism", 1)), 1)
+
+    def submit_job(self, job: Job) -> None:
+        self.buffer.append(job)
+
+    def n_waiting(self) -> int:
+        return len(self.buffer)
+
+    def prepare_schedule(self, plans) -> None:
+        self.pool.prepare(plans)
+
+    def flush(self) -> bool:
+        return self.pool.flush_tenant(self)
+
+    def shutdown(self, shutdown_workers: bool = False) -> None:
+        # the tenant leaves; the pool (and its backend) belong to everyone
+        self.pool.release_tenant(self.tenant_id)
+
+    # -------------------------------------------------------------- delivery
+    def _finish(self, job: Job, loss: float) -> None:
+        job.time_it("finished")
+        if np.isfinite(loss):
+            job.result = {"loss": float(loss), "info": {}}
+        else:
+            job.result = None
+            job.exception = job.exception or (
+                f"non-finite loss {loss!r} at budget {job.kwargs['budget']}"
+            )
+        self.total_evaluated += 1
+        obs.get_metrics().counter(
+            f"serve.tenant.{self.tenant_id}.configs_done"
+        ).inc()
+        # burst delivery, deferred refit — same contract (and reason) as
+        # BatchedExecutor._finish: the model refits once at next proposal
+        self._new_result_callback(job, update_model=False)
+
+    def _crash_wave(self, jobs: List[Job], why: str) -> None:
+        for j in jobs:
+            j.exception = why
+            self._finish(j, float("nan"))
+
+
+class ServePool:
+    """The shared serving backend: fair scheduling + megabatched dispatch.
+
+    ``backend`` is any batched evaluation backend (``VmapBackend``-shaped:
+    ``eval_fn``, ``evaluate(vectors, budget)``, ``parallelism``, optional
+    ``mesh``/``axis``); ``configspace`` is the pool's ONE search space —
+    cross-tenant packing requires a shared objective and vector dimension,
+    so a service hosts one (space, objective) pair per pool (docs/
+    serving.md "Shape compatibility").
+    """
+
+    def __init__(
+        self,
+        backend,
+        configspace: ConfigurationSpace,
+        scheduler: Optional[DeficitFairScheduler] = None,
+        admission: Optional[AdmissionController] = None,
+        pack_width: int = 8,
+        pack_min: int = 2,
+        pack_window_s: float = 0.01,
+        round_capacity: Optional[float] = None,
+        logger: Optional[logging.Logger] = None,
+    ):
+        from hpbandster_tpu.utils.compile_cache import (
+            enable_persistent_compile_cache,
+        )
+
+        enable_persistent_compile_cache()
+        self.backend = backend
+        self.configspace = configspace
+        self.scheduler = scheduler or DeficitFairScheduler()
+        self.admission = admission or AdmissionController()
+        #: static lanes per packed program (one compiled program per
+        #: bucket — the <= len(bucket_set) ledger contract)
+        self.pack_width = max(int(pack_width), 1)
+        #: packing engages at this group size; below it the solo bucket
+        #: program runs (no padding-lane waste for a lone bracket)
+        self.pack_min = max(int(pack_min), 2)
+        self.pack_window_s = max(float(pack_window_s), 0.0)
+        #: max cost one round may dispatch (None = everything selectable);
+        #: the saturation knob fairness is measured under
+        self.round_capacity = round_capacity
+        self.logger = logger or logging.getLogger("hpbandster_tpu.serve")
+
+        self._cond = threading.Condition()
+        self._queues: Dict[str, List[_WorkItem]] = {}
+        self._weights: Dict[str, float] = {}
+        self._leader: Optional[str] = None
+        self._rounds = 0
+        self._bucket_plans: List = []
+        self._bucket_shapes: set = set()
+        self._bucket_set = None
+        self._precompile = None
+        #: active facade count per tenant (a tenant may run several
+        #: concurrent sweeps, each driving its OWN facade — per-facade
+        #: result callbacks must never mix; fairness stays per tenant
+        #: because the work queues key on tenant_id, not facade)
+        self._tenants: Dict[str, int] = {}
+
+    # ------------------------------------------------------------- tenants
+    def executor_for(self, tenant_id: str, weight: Optional[float] = None):
+        """A fresh executor facade for ONE sweep of ``tenant_id`` (each
+        concurrent sweep gets its own; the tenant's fair share does not
+        grow with its sweep count)."""
+        tenant = str(tenant_id)
+        with self._cond:
+            self._tenants[tenant] = self._tenants.get(tenant, 0) + 1
+            self._queues.setdefault(tenant, [])
+            self._weights[tenant] = float(
+                weight if weight is not None
+                else self.admission.quota(tenant).weight
+            )
+        return _TenantExecutor(self, tenant)
+
+    def release_tenant(self, tenant_id: str) -> None:
+        tenant = str(tenant_id)
+        with self._cond:
+            n = self._tenants.get(tenant, 0) - 1
+            if n > 0:
+                self._tenants[tenant] = n
+            else:
+                self._tenants.pop(tenant, None)
+                if not self._queues.get(tenant):
+                    # fully gone (no facades, nothing queued): prune the
+                    # per-tenant bookkeeping so tenant churn cannot grow
+                    # the pool/scheduler state without bound
+                    self._queues.pop(tenant, None)
+                    self._weights.pop(tenant, None)
+                    self.scheduler.forget(tenant)
+            self._cond.notify_all()
+
+    def tenants(self) -> List[str]:
+        with self._cond:
+            return sorted(self._tenants)
+
+    # ------------------------------------------------------------- schedule
+    def prepare(self, plans) -> None:
+        """A tenant master announced its remaining schedule: widen the
+        shared bucket set over the union of every tenant's plans and
+        background-precompile both the solo and the packed programs."""
+        from hpbandster_tpu.ops.buckets import (
+            build_bucket_set,
+            precompile_buckets,
+        )
+
+        fusable = [p for p in plans if len(p.num_configs) >= 2]
+        if not fusable:
+            return
+        with self._cond:
+            # dedupe by shape: a long-lived pool sees the same specs
+            # resubmitted forever, and an unchanged shape union needs no
+            # plan growth, no bucket-set rebuild, and no fresh precompile
+            fresh = []
+            for p in fusable:
+                sig = (tuple(p.num_configs), tuple(p.budgets))
+                if sig not in self._bucket_shapes:
+                    self._bucket_shapes.add(sig)
+                    fresh.append(p)
+            if not fresh:
+                return
+            self._bucket_plans.extend(fresh)
+            mesh = getattr(self.backend, "mesh", None)
+            axis = getattr(self.backend, "axis", "config")
+            mesh_size = 1
+            if mesh is not None:
+                mesh_size = int(dict(mesh.shape).get(axis, 1))
+            self._bucket_set = build_bucket_set(
+                self._bucket_plans, mesh_size=mesh_size
+            )
+            bucket_set = self._bucket_set
+        try:
+            self._precompile = precompile_buckets(
+                self.backend.eval_fn,
+                bucket_set,
+                d=self.configspace.dim,
+                mesh=mesh,
+                axis=axis,
+                background=True,
+            )
+        except Exception:
+            # precompile is an optimization; dispatch-time compile works
+            self.logger.exception("bucket precompile failed; continuing")
+        self.logger.debug(
+            "serve bucket set: %d shapes -> %d programs",
+            len(bucket_set.assignment), len(bucket_set.buckets),
+        )
+
+    def _placement(self, info) -> Optional[Tuple[Any, Any, int]]:
+        """(bucket_plan, member_plan, entry) for a bracket shape, or
+        None. Returns the BucketPlan VALUE, not an index — a later
+        bucket-set rebuild must not re-point in-flight items."""
+        from hpbandster_tpu.ops.bracket import BracketPlan
+
+        with self._cond:
+            bucket_set = self._bucket_set
+        if bucket_set is None:
+            return None
+        placed = bucket_set.lookup(info["num_configs"], info["budgets"])
+        if placed is None:
+            return None
+        bucket_idx, entry = placed
+        plan = BracketPlan(
+            num_configs=tuple(info["num_configs"]),
+            budgets=tuple(info["budgets"]),
+        )
+        return bucket_set.buckets[bucket_idx], plan, entry
+
+    # ----------------------------------------------------------------- flush
+    def flush_tenant(self, facade: _TenantExecutor) -> bool:
+        """One tenant's flush: serve cached results, queue fresh work,
+        wait (possibly leading rounds) until it completes, deliver."""
+        if not facade.buffer and not facade._fused_cache:
+            return False
+        jobs, facade.buffer = facade.buffer, []
+
+        served = False
+        remaining: List[Job] = []
+        for job in jobs:
+            key = (job.id, float(job.kwargs["budget"]))
+            if key in facade._fused_cache:
+                job.time_it("started")
+                facade._finish(job, facade._fused_cache.pop(key))
+                served = True
+            else:
+                remaining.append(job)
+        if not remaining:
+            return served
+
+        items = self._build_items(facade.tenant_id, remaining)
+        self._enqueue_and_wait(facade.tenant_id, items)
+        self._deliver(facade, items)
+        return True
+
+    def _build_items(
+        self, tenant: str, jobs: List[Job]
+    ) -> List[_WorkItem]:
+        """Buffered jobs -> cost-stamped work items (complete stage-0
+        bracket waves fuse; the rest stage-batches by budget)."""
+        groups: Dict[int, List[Job]] = {}
+        leftovers: List[Job] = []
+        for j in jobs:
+            info = getattr(j, "bracket_info", None)
+            if info is None or info["stage"] != 0 or len(info["num_configs"]) < 2:
+                leftovers.append(j)
+            else:
+                groups.setdefault(j.id[0], []).append(j)
+
+        items: List[_WorkItem] = []
+        for iteration, gjobs in sorted(groups.items()):
+            info = gjobs[0].bracket_info
+            complete = (
+                all(getattr(j, "bracket_info", None) == info for j in gjobs)
+                and len(gjobs) == info["num_configs"][0]
+            )
+            placed = self._placement(info) if complete else None
+            if placed is None:
+                leftovers.extend(gjobs)
+                continue
+            bucket, plan, entry = placed
+            jobs_sorted = sorted(gjobs, key=lambda j: j.id)
+            item = _WorkItem(
+                "bracket", tenant, jobs_sorted,
+                cost=work_cost(plan.num_configs, plan.budgets),
+            )
+            item.info = info
+            item.vectors = self._vectors(jobs_sorted)
+            item.bucket = bucket
+            item.plan = plan
+            item.entry = entry
+            items.append(item)
+
+        by_budget: Dict[float, List[Job]] = {}
+        for j in leftovers:
+            by_budget.setdefault(float(j.kwargs["budget"]), []).append(j)
+        for budget, group in sorted(by_budget.items()):
+            item = _WorkItem(
+                "stage", tenant, group, cost=len(group) * float(budget)
+            )
+            item.budget = budget
+            item.vectors = self._vectors(group)
+            items.append(item)
+        return items
+
+    def _vectors(self, jobs: Sequence[Job]) -> np.ndarray:
+        return np.stack([
+            np.nan_to_num(
+                self.configspace.to_vector(j.kwargs["config"]), nan=0.0
+            )
+            for j in jobs
+        ]).astype(np.float32)
+
+    # ------------------------------------------------------- rounds/waiting
+    def _enqueue_and_wait(
+        self, tenant: str, items: List[_WorkItem]
+    ) -> None:
+        if not items:
+            return
+        now = time.monotonic()
+        m = obs.get_metrics()
+        with self._cond:
+            q = self._queues.setdefault(tenant, [])
+            for it in items:
+                it.enqueue_mono = now
+            q.extend(items)
+            m.gauge("serve.queue_items").set(
+                sum(len(qq) for qq in self._queues.values())
+            )
+            self._cond.notify_all()
+
+        first_wait = True
+        while True:
+            with self._cond:
+                if all(it.done for it in items):
+                    return
+                if self._leader is not None:
+                    self._cond.wait(0.05)
+                    continue
+                self._leader = tenant
+            try:
+                if first_wait and self.pack_window_s:
+                    # let co-arriving tenants' waves land before the first
+                    # round of this leadership stint, so they pack
+                    time.sleep(self.pack_window_s)
+                    first_wait = False
+                self._round()
+            finally:
+                with self._cond:
+                    self._leader = None
+                    self._cond.notify_all()
+
+    def _round(self) -> None:
+        """One scheduling round: fair-select queued items, dispatch them
+        (megabatched where bucket-compatible), mark results."""
+        m = obs.get_metrics()
+        with self._cond:
+            queues = {t: list(q) for t, q in self._queues.items() if q}
+            if not queues:
+                return
+            selected = self.scheduler.select(
+                queues, capacity=self.round_capacity, weights=self._weights
+            )
+            for tenant, item in selected:
+                self._queues[tenant].remove(item)
+            self._rounds += 1
+            m.counter("serve.rounds").inc()
+            m.gauge("serve.queue_items").set(
+                sum(len(qq) for qq in self._queues.values())
+            )
+        wait_now = time.monotonic()
+        for tenant, item in selected:
+            wait_s = max(wait_now - item.enqueue_mono, 0.0)
+            m.histogram("serve.queue_wait_s").observe(wait_s)
+            m.histogram(f"serve.tenant.{tenant}.queue_wait_s").observe(
+                wait_s
+            )
+        try:
+            self._run_items([item for _, item in selected])
+        finally:
+            with self._cond:
+                for _, item in selected:
+                    item.done = True
+                    if item.error is None and item.result is None:
+                        item.error = "round aborted before results landed"
+                self._cond.notify_all()
+
+    # ------------------------------------------------------------- dispatch
+    def _run_items(self, items: List[_WorkItem]) -> None:
+        """Evaluate one round's selection. Bracket items group by bucket:
+        groups of >= pack_min become packed megabatch dispatches (chunked
+        at pack_width), smaller ones ride the solo bucket program; stage
+        items batch by budget across tenants. Failures are contained per
+        item (one tenant's wave crashes, the round survives)."""
+        brackets = [it for it in items if it.kind == "bracket"]
+        stages = [it for it in items if it.kind == "stage"]
+
+        by_bucket: Dict[Any, List[_WorkItem]] = {}
+        for it in brackets:
+            by_bucket.setdefault(it.bucket, []).append(it)
+
+        d = self.configspace.dim
+        mesh = getattr(self.backend, "mesh", None)
+        axis = getattr(self.backend, "axis", "config")
+        #: (fetch, items) pairs — every dispatch launches before the
+        #: first fetch, so device work overlaps across groups
+        pending: List[Tuple[Callable[[], None], List[_WorkItem]]] = []
+
+        for bucket, group in sorted(
+            by_bucket.items(), key=lambda kv: kv[0]
+        ):
+            chunks: List[List[_WorkItem]] = []
+            if len(group) >= self.pack_min:
+                for i in range(0, len(group), self.pack_width):
+                    chunks.append(group[i:i + self.pack_width])
+            else:
+                chunks = [[it] for it in group]
+            for chunk in chunks:
+                if len(chunk) >= self.pack_min:
+                    pending.append(self._dispatch_packed(chunk, bucket, d))
+                else:
+                    pending.append(
+                        self._dispatch_solo(chunk[0], bucket, mesh, axis)
+                    )
+
+        for fetch, chunk_items in pending:
+            try:
+                with obs.span(
+                    "serve_fetch", n_brackets=len(chunk_items),
+                ):
+                    fetch()
+            except Exception as e:
+                self.logger.exception("serve fetch failed")
+                for it in chunk_items:
+                    it.error = f"serve fetch failed: {e!r}"
+
+        for budget_group in self._stage_groups(stages):
+            self._run_stage_group(budget_group)
+
+    def _dispatch_packed(
+        self, chunk: List[_WorkItem], bucket, d: int
+    ) -> Tuple[Callable[[], None], List[_WorkItem]]:
+        """Launch one packed cross-tenant dispatch; returns its fetcher."""
+        mesh = getattr(self.backend, "mesh", None)
+        axis = getattr(self.backend, "axis", "config")
+        entries = [
+            PackEntry(it.tenant, it.vectors, it.plan, it.entry)
+            for it in chunk
+        ]
+        try:
+            runner = make_mega_runner(
+                self.backend.eval_fn, bucket,
+                pack_width=self.pack_width, mesh=mesh, axis=axis,
+            )
+            with obs.span(
+                "megabatch_dispatch", n_brackets=len(chunk),
+                tenants=len({it.tenant for it in chunk}),
+            ):
+                packed = runner.dispatch(entries, d)
+        except Exception as e:
+            self.logger.exception("megabatch dispatch failed")
+            for it in chunk:
+                it.error = f"megabatch dispatch failed: {e!r}"
+            return (lambda: None), chunk
+
+        def fetch(runner=runner, packed=packed, entries=entries,
+                  chunk=chunk):
+            for it, stages in zip(chunk, runner.demux(packed, entries)):
+                it.result = stages
+
+        return fetch, chunk
+
+    def _dispatch_solo(
+        self, item: _WorkItem, bucket, mesh, axis
+    ) -> Tuple[Callable[[], None], List[_WorkItem]]:
+        """A lone bracket rides the solo bucket program — no padding-lane
+        waste, and the executable is shared with every other solo path in
+        the process (same ``_BUCKET_FN_CACHE`` entry)."""
+        from hpbandster_tpu.ops.buckets import (
+            make_bucketed_bracket_fn,
+            slice_member_stages,
+        )
+
+        counts = np.zeros(bucket.depth, np.int32)
+        for s, k in enumerate(item.plan.num_configs):
+            counts[item.entry + s] = int(k)
+        try:
+            runner = make_bucketed_bracket_fn(
+                self.backend.eval_fn, bucket, mesh=mesh, axis=axis
+            )
+            with obs.span("fused_dispatch", n=len(item.jobs), bucketed=True):
+                packed = runner.dispatch(item.vectors, counts)
+        except Exception as e:
+            self.logger.exception("solo bucket dispatch failed")
+            item.error = f"solo bucket dispatch failed: {e!r}"
+            return (lambda: None), [item]
+
+        def fetch(runner=runner, packed=packed, item=item):
+            item.result = slice_member_stages(
+                runner.unpack(packed), item.plan, item.entry
+            )
+
+        return fetch, [item]
+
+    @staticmethod
+    def _stage_groups(
+        stages: List[_WorkItem],
+    ) -> List[List[_WorkItem]]:
+        by_budget: Dict[float, List[_WorkItem]] = {}
+        for it in stages:
+            by_budget.setdefault(float(it.budget), []).append(it)
+        return [by_budget[b] for b in sorted(by_budget)]
+
+    def _run_stage_group(self, group: List[_WorkItem]) -> None:
+        """One budget's stage batch, cross-tenant: concatenate every
+        item's vectors into one backend dispatch, split losses back."""
+        budget = float(group[0].budget)
+        vectors = np.concatenate([it.vectors for it in group])
+        try:
+            with obs.span(
+                "stage_batch", n=len(vectors), budget=budget,
+                tenants=len({it.tenant for it in group}),
+            ):
+                losses = np.asarray(self.backend.evaluate(vectors, budget))
+        except Exception as e:
+            self.logger.exception(
+                "serve stage batch failed at budget %g", budget
+            )
+            for it in group:
+                it.error = f"stage batch failed: {e!r}"
+            return
+        off = 0
+        for it in group:
+            n = len(it.jobs)
+            it.result = losses[off:off + n]
+            off += n
+
+    # ------------------------------------------------------------- delivery
+    def _deliver(
+        self, facade: _TenantExecutor, items: List[_WorkItem]
+    ) -> None:
+        """Hand one tenant's finished items to its master — on the
+        tenant's own flush thread, under its master's re-entrant lock."""
+        for item in items:
+            for j in item.jobs:
+                j.time_it("started")
+            if item.error is not None or item.result is None:
+                facade._crash_wave(
+                    item.jobs, item.error or "no result from pool round"
+                )
+                continue
+            if item.kind == "stage":
+                for j, loss in zip(item.jobs, np.asarray(item.result)):
+                    facade._finish(j, float(loss))
+                continue
+            stages = item.result
+            info = item.info
+            stage0_losses = np.asarray(stages[0][1])
+            for s, (idx, losses) in enumerate(stages[1:], start=1):
+                budget = info["budgets"][s]
+                for i, loss in zip(np.asarray(idx), np.asarray(losses)):
+                    cid = item.jobs[int(i)].id
+                    facade._fused_cache[(cid, float(budget))] = float(loss)
+            for j, loss in zip(item.jobs, stage0_losses):
+                facade._finish(j, float(loss))
+
+    # ------------------------------------------------------------ inspection
+    def snapshot(self) -> Dict[str, Any]:
+        """Pool introspection (the frontend's health in_flight section)."""
+        with self._cond:
+            return {
+                "tenants": sorted(self._tenants),
+                "queued_items": {
+                    t: len(q) for t, q in self._queues.items() if q
+                },
+                "rounds": self._rounds,
+                "buckets": (
+                    len(self._bucket_set.buckets)
+                    if self._bucket_set is not None else 0
+                ),
+                "served_cost": {
+                    t: round(c, 3)
+                    for t, c in sorted(
+                        self.scheduler.served_cost.items()
+                    )
+                },
+            }

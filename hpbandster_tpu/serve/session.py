@@ -1,0 +1,291 @@
+"""Tenant sessions: sweep specs, warm models, per-sweep drivers.
+
+The tenant-facing vocabulary of the serving tier:
+
+* :class:`SweepSpec` — one sweep submission, JSON-shaped (it crosses the
+  RPC boundary verbatim): optimizer family + HyperBand knobs + bracket
+  count. The pool's search space and objective are SERVER-side (a pool
+  hosts one ``(space, objective)`` pair — the shape-compatibility rule
+  megabatching needs, docs/serving.md); tenants parameterize the sweep,
+  not the space.
+* :class:`TenantSession` — one tenant's durable server-side state:
+  quota, running sweeps, and the WARM MODEL — the previous sweep's
+  :class:`~hpbandster_tpu.core.result.Result`, replayed into the next
+  sweep's config generator through the existing
+  ``core/warmstart.py`` path (``previous_result=``), so a returning
+  tenant's KDE resumes from everything it already paid to learn.
+* :class:`TenantStore` — the session registry (thread-safe; the frontend
+  and tests share it).
+* :class:`TenantMaster` — drives ONE sweep: builds the optimizer with
+  the tenant's identity stamp (``tenant_id=`` on ``Master``), the
+  pool's executor facade, and the session's warm result; records the
+  finished Result back into the session.
+
+Everything here is host-side bookkeeping — no jax imports.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from hpbandster_tpu.serve.scheduler import TenantQuota
+
+__all__ = ["SweepSpec", "TenantSession", "TenantStore", "TenantMaster"]
+
+#: optimizer families a spec may name (server-side construction — the
+#: tenant never ships code)
+OPTIMIZERS = ("bohb", "random")
+
+
+class SweepSpec:
+    """One sweep submission; validates eagerly so rejects carry reasons."""
+
+    def __init__(
+        self,
+        optimizer: str = "bohb",
+        n_iterations: int = 1,
+        eta: float = 3.0,
+        min_budget: float = 1.0,
+        max_budget: float = 9.0,
+        num_samples: int = 32,
+        random_fraction: float = 1 / 3,
+        seed: Optional[int] = None,
+        warm_start: bool = True,
+    ):
+        if optimizer not in OPTIMIZERS:
+            raise ValueError(
+                f"unknown optimizer {optimizer!r} (supported: {OPTIMIZERS})"
+            )
+        if int(n_iterations) < 1:
+            raise ValueError("n_iterations must be >= 1")
+        if not (0 < float(min_budget) <= float(max_budget)):
+            raise ValueError("need 0 < min_budget <= max_budget")
+        if float(eta) <= 1:
+            raise ValueError("eta must be > 1")
+        self.optimizer = optimizer
+        self.n_iterations = int(n_iterations)
+        self.eta = float(eta)
+        self.min_budget = float(min_budget)
+        self.max_budget = float(max_budget)
+        self.num_samples = int(num_samples)
+        self.random_fraction = float(random_fraction)
+        self.seed = seed if seed is None else int(seed)
+        #: opt out of the session's warm model (a fresh-eyes sweep)
+        self.warm_start = bool(warm_start)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SweepSpec":
+        if not isinstance(d, dict):
+            raise ValueError("sweep spec must be a JSON object")
+        known = {
+            "optimizer", "n_iterations", "eta", "min_budget", "max_budget",
+            "num_samples", "random_fraction", "seed", "warm_start",
+        }
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown sweep spec field(s): {sorted(unknown)}")
+        return cls(**d)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "optimizer": self.optimizer,
+            "n_iterations": self.n_iterations,
+            "eta": self.eta,
+            "min_budget": self.min_budget,
+            "max_budget": self.max_budget,
+            "num_samples": self.num_samples,
+            "random_fraction": self.random_fraction,
+            "seed": self.seed,
+            "warm_start": self.warm_start,
+        }
+
+    def estimated_cost(self) -> float:
+        """Upper-bound configs x budget cost of one sweep under this spec
+        (the admission controller's in-flight currency)."""
+        from hpbandster_tpu.ops.bracket import hyperband_bracket
+        from hpbandster_tpu.serve.scheduler import work_cost
+
+        total = 0.0
+        for i in range(self.n_iterations):
+            plan = hyperband_bracket(
+                i, self.min_budget, self.max_budget, self.eta
+            )
+            total += work_cost(plan.num_configs, plan.budgets)
+        return total
+
+
+class TenantSession:
+    """One tenant's durable server-side state (store-owned, store-locked)."""
+
+    def __init__(self, tenant_id: str, quota: Optional[TenantQuota] = None):
+        self.tenant_id = str(tenant_id)
+        self.quota = quota or TenantQuota()
+        self.created_wall = time.time()
+        #: sweep_id -> status dict (the frontend's sweep_status payload)
+        self.sweeps: Dict[str, Dict[str, Any]] = {}
+        #: the newest finished sweep's Result — the warm model the next
+        #: submission resumes from (core/warmstart.py replay)
+        self.warm_result: Any = None
+        self.sweeps_completed = 0
+
+    def active_sweeps(self) -> int:
+        return sum(
+            1 for s in self.sweeps.values()
+            if s.get("state") in ("queued", "running")
+        )
+
+
+class TenantStore:
+    """Thread-safe tenant registry; sessions are created on first touch."""
+
+    def __init__(self, default_quota: Optional[TenantQuota] = None):
+        self._lock = threading.Lock()
+        self._sessions: Dict[str, TenantSession] = {}
+        self.default_quota = default_quota
+
+    def session(self, tenant_id: str) -> TenantSession:
+        with self._lock:
+            s = self._sessions.get(str(tenant_id))
+            if s is None:
+                quota = (
+                    TenantQuota(**self.default_quota.to_dict())
+                    if self.default_quota is not None else None
+                )
+                s = TenantSession(tenant_id, quota=quota)
+                self._sessions[str(tenant_id)] = s
+            return s
+
+    def tenants(self) -> List[str]:
+        with self._lock:
+            return sorted(self._sessions)
+
+    def register_sweep(
+        self, tenant_id: str, sweep_id: str, run: Dict[str, Any]
+    ) -> None:
+        """Record a sweep under the store lock — census readers iterate
+        ``session.sweeps`` under it, so unlocked inserts could blow up a
+        concurrent iteration."""
+        s = self.session(tenant_id)
+        with self._lock:
+            s.sweeps[sweep_id] = run
+
+    def unregister_sweep(self, tenant_id: str, sweep_id: str) -> None:
+        """Drop a reservation whose sweep never came to life (construction
+        failed after admission) — the quota slot returns to the tenant."""
+        with self._lock:
+            s = self._sessions.get(str(tenant_id))
+            if s is not None:
+                s.sweeps.pop(sweep_id, None)
+
+    def active_sweeps(self, tenant_id: str) -> int:
+        with self._lock:
+            s = self._sessions.get(str(tenant_id))
+            return s.active_sweeps() if s is not None else 0
+
+    def total_active_sweeps(self) -> int:
+        with self._lock:
+            return sum(
+                s.active_sweeps() for s in self._sessions.values()
+            )
+
+    def remember_result(self, tenant_id: str, result: Any) -> None:
+        """Keep ``result`` as the tenant's warm model for its next sweep."""
+        s = self.session(tenant_id)
+        with self._lock:
+            s.warm_result = result
+            s.sweeps_completed += 1
+
+    def warm(self, tenant_id: str) -> Any:
+        with self._lock:
+            s = self._sessions.get(str(tenant_id))
+            return s.warm_result if s is not None else None
+
+
+class TenantMaster:
+    """Drive ONE tenant sweep against the shared pool.
+
+    The ``Master`` variant the serving tier needed: per-tenant iteration
+    state and model, but the executor is a pool facade the tenant does
+    not own — ``shutdown`` releases the facade and leaves the pool (and
+    its backend, bucket programs, and other tenants) running.
+    """
+
+    def __init__(
+        self,
+        pool,
+        tenant_id: str,
+        spec: SweepSpec,
+        store: Optional[TenantStore] = None,
+        run_id: Optional[str] = None,
+        sweep_id: Optional[str] = None,
+    ):
+        self.pool = pool
+        self.tenant_id = str(tenant_id)
+        self.spec = spec
+        self.store = store
+        self.sweep_id = (
+            str(sweep_id) if sweep_id
+            else f"{self.tenant_id}-{uuid.uuid4().hex[:8]}"
+        )
+        self.run_id = run_id or f"serve-{self.sweep_id}"
+        previous = (
+            store.warm(tenant_id)
+            if (store is not None and spec.warm_start) else None
+        )
+        executor = pool.executor_for(tenant_id)
+        common = dict(
+            configspace=pool.configspace,
+            executor=executor,
+            run_id=self.run_id,
+            tenant_id=self.tenant_id,
+            eta=spec.eta,
+            min_budget=spec.min_budget,
+            max_budget=spec.max_budget,
+            seed=spec.seed,
+        )
+        try:
+            if spec.optimizer == "bohb":
+                from hpbandster_tpu.optimizers.bohb import BOHB
+
+                self.optimizer = BOHB(
+                    num_samples=spec.num_samples,
+                    random_fraction=spec.random_fraction,
+                    previous_result=previous,
+                    **common,
+                )
+            else:
+                from hpbandster_tpu.optimizers.randomsearch import RandomSearch
+
+                self.optimizer = RandomSearch(**common)
+        except Exception:
+            # the facade was already minted: release it, or the pool's
+            # tenant census/weights keep a phantom entry forever
+            executor.shutdown()
+            raise
+        self.result: Any = None
+
+    def run(self):
+        """Run the sweep to completion; returns (and remembers) the
+        Result. The warm model is updated even on a later submission's
+        behalf — what the tenant paid to learn, the tenant keeps."""
+        try:
+            self.result = self.optimizer.run(
+                n_iterations=self.spec.n_iterations
+            )
+        finally:
+            self.optimizer.shutdown()
+        if self.store is not None:
+            self.store.remember_result(self.tenant_id, self.result)
+        return self.result
+
+    def progress(self) -> Dict[str, Any]:
+        """Live sweep progress (the frontend's status poll body)."""
+        executor = self.optimizer.executor
+        return {
+            "configs_done": getattr(executor, "total_evaluated", 0),
+            "iterations": len(self.optimizer.iterations),
+            "active_iterations": len(self.optimizer.active_iterations()),
+        }

@@ -28,3 +28,9 @@ def forged_audit(cid):
     # an audit record is a journal record like any other: fabricating its
     # trace breaks the lineage join exactly like fabricating a job's
     obs.emit("config_sampled", config_id=cid, trace_id="feedface")  # BAD
+
+
+def forged_tenant(cid):
+    # tenant identity is stamped by use_tenant's context, never a kwarg:
+    # a hand-written tenant_id mis-attributes another tenant's work
+    obs.emit("job_finished", config_id=cid, tenant_id="acme")  # BAD

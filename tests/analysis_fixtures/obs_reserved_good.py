@@ -18,6 +18,13 @@ def log_result(cid, trace_ctx):
         emit("job_started", worker="w0", queue_wait_s=0.01)
 
 
+def tenant_scoped(cid):
+    # tenant identity enters records the same way: through the context
+    with obs.use_tenant("acme"):
+        obs.emit("job_finished", config_id=cid, budget=9.0)
+        emit("config_sampled", config_id=cid, budget=1.0, tenant="x")  # plain 'tenant' kwarg is not the reserved stamp
+
+
 def timed_region():
     with span("compute", budget=3.0):
         pass

@@ -54,6 +54,20 @@ def test_obs_tree_is_scanned_and_clean():
     assert findings == [], "\n" + format_report(findings)
 
 
+def test_serve_tree_is_scanned_and_clean():
+    """Same coverage guarantee for the serving tier: every serve/ module
+    is inside the gate's walk and clean under the full rule pack."""
+    from hpbandster_tpu.analysis import collect_files
+
+    serve_tree = REPO / "hpbandster_tpu" / "serve"
+    scanned = set(collect_files(SCAN))
+    serve_files = {str(p) for p in serve_tree.glob("*.py")}
+    assert serve_files, "hpbandster_tpu/serve has no python files?"
+    assert serve_files <= scanned, sorted(serve_files - scanned)
+    findings = run([str(serve_tree)])
+    assert findings == [], "\n" + format_report(findings)
+
+
 def test_cli_exits_zero_on_clean_tree(capsys):
     assert main(SCAN) == 0
     assert "clean" in capsys.readouterr().out

@@ -441,6 +441,11 @@ def _stub_tiers(monkeypatch, calls):
         bench, "bench_report_100k",
         lambda **kw: calls.setdefault("report_100k", True)
         and {"n_events": 100000, "events_per_s": 1, "deterministic": True})
+    monkeypatch.setattr(
+        bench, "bench_multitenant",
+        lambda **kw: calls.setdefault("multitenant", True)
+        and {"n_tenants": 16, "median": 100.0, "iqr": [90.0, 110.0],
+             "packing_efficiency": 1.2, "p95_queue_wait_s": 0.05})
 
 
 class TestFallbackContract:
@@ -592,8 +597,8 @@ class TestTierSelection:
         assert set(bench.TIER_ORDER) == {
             "cnn", "cnn_wide", "pallas", "resnet", "transformer",
             "fused10k", "chunked10k", "chunked_compile", "fused", "rpc",
-            "batched", "teacher", "obs_overhead", "runtime_overhead",
-            "collector_overhead", "report_100k",
+            "batched", "teacher", "multitenant", "obs_overhead",
+            "runtime_overhead", "collector_overhead", "report_100k",
         }
 
 

@@ -1,0 +1,1018 @@
+"""Serving-tier tests: fairness, admission, megabatch parity, tenant e2e.
+
+Acceptance bars (ISSUE 8):
+
+* megabatch bit-parity — a member bracket's results from a packed
+  cross-tenant dispatch are IDENTICAL to dispatching it solo, and the
+  packed path compiles <= len(bucket_set) programs (ledger-pinned);
+* deficit fairness — under saturation no tenant falls below 80% of its
+  deficit-fair share;
+* admission — over-quota submissions reject with machine-readable
+  reasons, never queue silently;
+* 3-tenant end-to-end over real sockets with per-tenant journal
+  reconciliation (every tenant's journal slice agrees with its own
+  sweep result);
+* a serve smoke test fast enough for tier-1 (< 5 s, not slow-marked).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hpbandster_tpu import obs
+from hpbandster_tpu.obs.runtime import get_compile_tracker
+from hpbandster_tpu.ops.bracket import BracketPlan
+from hpbandster_tpu.ops.buckets import (
+    build_bucket_set,
+    make_bucketed_bracket_fn,
+)
+from hpbandster_tpu.serve import (
+    AdmissionController,
+    DeficitFairScheduler,
+    PackEntry,
+    ServeFrontend,
+    ServePool,
+    SweepSpec,
+    TenantMaster,
+    TenantQuota,
+    TenantStore,
+    make_mega_runner,
+    pack_members,
+    work_cost,
+)
+from hpbandster_tpu.workloads.toys import branin_from_vector, branin_space
+
+
+class _Item:
+    def __init__(self, cost):
+        self.cost = float(cost)
+
+
+def _drain(sched, queues, capacity, weights=None, max_rounds=10_000):
+    """Run scheduler rounds until every queue drains; returns served
+    cost per tenant in completion order."""
+    rounds = 0
+    while any(queues.values()) and rounds < max_rounds:
+        selected = sched.select(queues, capacity=capacity, weights=weights)
+        for tenant, item in selected:
+            queues[tenant].remove(item)
+        rounds += 1
+    assert rounds < max_rounds, "scheduler failed to drain"
+    return rounds
+
+
+# --------------------------------------------------------------- scheduler
+class TestDeficitFairScheduler:
+    def test_whale_cannot_starve_minnow(self):
+        """Equal weights, whale floods cheap items, minnow trickles:
+        while both are backlogged each gets >= 80% of the 50/50 share."""
+        sched = DeficitFairScheduler(quantum=8.0)
+        queues = {
+            "whale": [_Item(1) for _ in range(400)],
+            "minnow": [_Item(1) for _ in range(100)],
+        }
+        # saturated: rounds of capacity 10 until the minnow drains
+        while queues["minnow"]:
+            for tenant, item in sched.select(queues, capacity=10):
+                queues[tenant].remove(item)
+        served = sched.served_cost
+        # during the contested interval the minnow finished its 100; the
+        # whale must not have gotten more than ~its half plus overshoot
+        contested = served["whale"] + 100.0
+        assert 100.0 >= 0.8 * (contested / 2), served
+
+    def test_mixed_item_sizes_share_by_cost(self):
+        """Whale items cost 9x minnow items; fair share is over COST,
+        not item count."""
+        sched = DeficitFairScheduler(quantum=9.0)
+        queues = {
+            "whale": [_Item(9) for _ in range(200)],
+            "minnow": [_Item(1) for _ in range(900)],
+        }
+        for _ in range(100):
+            for tenant, item in sched.select(queues, capacity=18):
+                queues[tenant].remove(item)
+        served = sched.served_cost
+        total = served["whale"] + served["minnow"]
+        for t in ("whale", "minnow"):
+            assert served[t] >= 0.8 * (total / 2), served
+
+    def test_weights_scale_share(self):
+        sched = DeficitFairScheduler(quantum=4.0)
+        queues = {
+            "gold": [_Item(1) for _ in range(600)],
+            "basic": [_Item(1) for _ in range(600)],
+        }
+        weights = {"gold": 3.0, "basic": 1.0}
+        for _ in range(100):
+            for tenant, item in sched.select(
+                queues, capacity=8, weights=weights
+            ):
+                queues[tenant].remove(item)
+        served = sched.served_cost
+        total = served["gold"] + served["basic"]
+        assert served["gold"] >= 0.8 * (total * 0.75), served
+        assert served["basic"] >= 0.8 * (total * 0.25), served
+
+    def test_oversized_item_still_flows(self):
+        """An item bigger than quantum AND capacity must not wedge the
+        queue — DRR's force-serve overshoot rule."""
+        sched = DeficitFairScheduler(quantum=1.0)
+        queues = {"t": [_Item(1000)]}
+        selected = sched.select(queues, capacity=5)
+        assert len(selected) == 1 and selected[0][0] == "t"
+
+    def test_oversized_item_not_starved_by_busy_peer(self):
+        """An item costlier than the whole round capacity must still flow
+        while ANOTHER tenant keeps the rounds non-empty: the empty-round
+        force-serve never fires, so liveness rides on the banked-deficit
+        overshoot — once the oversized tenant's credits cover the cost,
+        it gets a round to itself."""
+        sched = DeficitFairScheduler(quantum=8.0)
+        big = _Item(150)
+        queues = {
+            "a": [big],
+            "b": [_Item(10) for _ in range(1000)],
+        }
+        served_big = False
+        for _ in range(50):  # deficit banks 50/round for a -> ~3 rounds
+            for tenant, item in sched.select(queues, capacity=100):
+                queues[tenant].remove(item)
+                if item is big:
+                    served_big = True
+            if served_big:
+                break
+        assert served_big, "oversized item starved behind busy peer"
+        # the overshoot was paid for: a's deficit went down by the cost
+        assert sched._deficit["a"] < 150
+
+    def test_idle_tenant_banks_nothing(self):
+        sched = DeficitFairScheduler(quantum=10.0)
+        # t idles for many rounds while u works
+        queues = {"t": [], "u": [_Item(1) for _ in range(50)]}
+        for _ in range(20):
+            for tenant, item in sched.select(queues, capacity=2):
+                queues[tenant].remove(item)
+        # t shows up: its deficit starts from one fresh quantum, not 200
+        assert sched._deficit.get("t", 0.0) == 0.0
+
+    def test_deterministic_selection(self):
+        def run():
+            sched = DeficitFairScheduler(quantum=5.0)
+            queues = {
+                "a": [_Item(3) for _ in range(10)],
+                "b": [_Item(2) for _ in range(10)],
+            }
+            order = []
+            while any(queues.values()):
+                for tenant, item in sched.select(queues, capacity=6):
+                    queues[tenant].remove(item)
+                    order.append((tenant, item.cost))
+            return order
+
+        assert run() == run()
+
+    def test_work_cost(self):
+        assert work_cost((9, 3, 1), (1.0, 3.0, 9.0)) == 9 + 9 + 9
+
+
+# --------------------------------------------------------------- admission
+class TestAdmission:
+    def test_sweep_cap_rejects_with_reason(self):
+        adm = AdmissionController(
+            default_quota=TenantQuota(max_active_sweeps=2)
+        )
+        ok = adm.admit_sweep("t", active_sweeps=1, total_active_sweeps=1)
+        assert ok and ok.reason is None
+        no = adm.admit_sweep("t", active_sweeps=2, total_active_sweeps=2)
+        assert not no and "max_active_sweeps" in no.reason
+
+    def test_pool_cap_rejects(self):
+        adm = AdmissionController(max_total_sweeps=3)
+        no = adm.admit_sweep("t", active_sweeps=0, total_active_sweeps=3)
+        assert not no and "max_total_sweeps" in no.reason
+
+    def test_inflight_cost_rejects(self):
+        adm = AdmissionController(
+            default_quota=TenantQuota(max_inflight_cost=100.0)
+        )
+        assert adm.admit_work("t", inflight_cost=50.0, item_cost=49.0)
+        no = adm.admit_work("t", inflight_cost=50.0, item_cost=51.0)
+        assert not no and "max_inflight_cost" in no.reason
+
+    def test_per_tenant_quota_override(self):
+        adm = AdmissionController(
+            default_quota=TenantQuota(max_active_sweeps=1)
+        )
+        adm.set_quota("vip", TenantQuota(max_active_sweeps=8))
+        assert adm.admit_sweep("vip", 4, 4)
+        assert not adm.admit_sweep("pleb", 1, 4)
+
+    def test_concurrent_submits_cannot_overshoot_quota(self, monkeypatch):
+        """The RPC server is threaded: N racing submits against a quota
+        of 2 must admit exactly 2 (check-then-register is atomic, no
+        TOCTOU), and concurrent census reads must never crash on the
+        session dict mutating underneath them.
+
+        Sweep completion is gated on an event until every submit has
+        been decided: a finished sweep legitimately frees quota, so a
+        real (fast) sweep racing the later submits would let a third
+        admission through and flake the exact-count assertion."""
+        gate = threading.Event()
+
+        class _GatedMaster:
+            def __init__(self, pool, tenant, spec, store=None, sweep_id=None):
+                import uuid
+
+                self.sweep_id = sweep_id or f"{tenant}-{uuid.uuid4().hex[:8]}"
+                self.result = None
+
+            def run(self):
+                assert gate.wait(timeout=60)
+
+            def progress(self):
+                return {}
+
+        monkeypatch.setattr(
+            "hpbandster_tpu.serve.frontend.TenantMaster", _GatedMaster
+        )
+        pool = ServePool(
+            _smoke_backend(), branin_space(seed=0), pack_window_s=0.02
+        )
+        store = TenantStore(
+            default_quota=TenantQuota(max_active_sweeps=2)
+        )
+        frontend = ServeFrontend(pool, store=store)
+        replies, errors = [], []
+
+        def submit(i):
+            try:
+                replies.append(frontend.submit_sweep(
+                    "acme",
+                    {"optimizer": "random", "n_iterations": 1,
+                     "max_budget": 9, "seed": i},
+                ))
+            except Exception as e:  # pragma: no cover - the regression
+                errors.append(e)
+
+        def census(stop):
+            while not stop.is_set():
+                frontend.tenant_quota("acme")
+
+        stop = threading.Event()
+        reader = threading.Thread(target=census, args=(stop,), daemon=True)
+        reader.start()
+        threads = [
+            threading.Thread(target=submit, args=(i,)) for i in range(12)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+        finally:
+            stop.set()
+            reader.join(timeout=5)
+        assert not errors, errors
+        accepted = [r for r in replies if r["accepted"]]
+        assert len(accepted) == 2, replies
+        assert all(
+            "max_active_sweeps" in r["reason"]
+            for r in replies if not r["accepted"]
+        )
+        gate.set()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            states = {
+                frontend.sweep_status("acme", r["sweep_id"])["state"]
+                for r in accepted
+            }
+            if states == {"done"}:
+                break
+            time.sleep(0.05)
+        assert states == {"done"}
+
+    def test_construction_failure_rejects_and_frees_quota(self, monkeypatch):
+        """A sweep that admission accepted but whose optimizer fails to
+        construct must answer as a reject (not a transport error), undo
+        its quota reservation, and release the pool facade it minted."""
+
+        class _Boom:
+            def __init__(self, pool, tenant, spec, store=None, sweep_id=None):
+                # mirror the real construction order: the facade is minted
+                # first, so the release path is what keeps the pool clean
+                self._executor = pool.executor_for(tenant)
+                try:
+                    raise RuntimeError("warm model replay exploded")
+                except Exception:
+                    self._executor.shutdown()
+                    raise
+
+        monkeypatch.setattr(
+            "hpbandster_tpu.serve.frontend.TenantMaster", _Boom
+        )
+        pool = ServePool(
+            _smoke_backend(), branin_space(seed=0), pack_window_s=0.0
+        )
+        store = TenantStore(default_quota=TenantQuota(max_active_sweeps=1))
+        frontend = ServeFrontend(pool, store=store)
+        reply = frontend.submit_sweep("acme", {"optimizer": "random"})
+        assert not reply["accepted"]
+        assert "warm model replay exploded" in reply["reason"]
+        # the reservation was undone: the tenant's quota slot is free again
+        assert store.active_sweeps("acme") == 0
+        assert frontend.tenant_quota("acme")["headroom_sweeps"] == 1
+        # ... and the pool carries no phantom tenant census entry
+        assert pool.tenants() == []
+
+    def test_tenant_master_releases_facade_on_construction_failure(self):
+        """The real construction path: a corrupt warm model blows up BOHB
+        construction AFTER the pool facade was minted — TenantMaster must
+        release it on the way out."""
+        pool = ServePool(
+            _smoke_backend(), branin_space(seed=0), pack_window_s=0.0
+        )
+        store = TenantStore()
+        store.remember_result("acme", object())  # not a Result
+        with pytest.raises(AttributeError):
+            TenantMaster(
+                pool, "acme", SweepSpec(optimizer="bohb"), store=store
+            )
+        assert pool.tenants() == []
+
+
+# ------------------------------------------------------------ tenant stamp
+class TestTenantStamp:
+    def test_event_carries_tenant_id_only_in_context(self):
+        with obs.use_tenant("acme"):
+            ev = obs.make_event("job_finished", {"budget": 1.0})
+        assert ev.fields["tenant_id"] == "acme"
+        ev2 = obs.make_event("job_finished", {"budget": 1.0})
+        assert "tenant_id" not in ev2.fields  # byte-compat: no field
+
+    def test_wire_envelope_round_trip(self):
+        with obs.use_tenant("acme"):
+            wire = obs.current_wire()
+        assert wire == {"tenant": "acme"}
+        assert obs.extract_tenant(wire) == "acme"
+        assert obs.extract_tenant({"trace_id": "x"}) is None
+        assert obs.extract_tenant(None) is None
+        # trace + tenant share the envelope
+        with obs.use_tenant("acme"), obs.use_trace(obs.new_trace("r")):
+            wire = obs.current_wire()
+        assert wire["tenant"] == "acme" and wire["trace_id"]
+
+    def test_rpc_handler_enters_tenant(self):
+        from hpbandster_tpu.parallel.rpc import RPCProxy, RPCServer
+
+        seen = {}
+        server = RPCServer("127.0.0.1", 0)
+        server.register(
+            "who", lambda: seen.setdefault("tenant", obs.current_tenant())
+        )
+        server.start()
+        try:
+            with obs.use_tenant("acme"):
+                RPCProxy(server.uri).call("who")
+            assert seen["tenant"] == "acme"
+        finally:
+            server.shutdown()
+
+    def test_dead_letter_carries_tenant(self):
+        from hpbandster_tpu.parallel.dispatcher import Dispatcher
+
+        d = Dispatcher(run_id="dl", nameserver="127.0.0.1",
+                       nameserver_port=1)
+        with obs.use_tenant("acme"):
+            assert d._rpc_register_result(
+                id=[0, 0, 0], result={"result": {"loss": 1.0}}
+            ) is False
+        letter = d.dead_letters.snapshot()[-1]
+        assert letter["tenant_id"] == "acme"
+        # no tenant context -> the default tenant, never a missing key
+        assert d._rpc_register_result(
+            id=[0, 0, 1], result={"result": {"loss": 2.0}}
+        ) is False
+        assert d.dead_letters.snapshot()[-1]["tenant_id"] == "default"
+
+
+# -------------------------------------------------------- megabatch parity
+def _parity_fixtures():
+    plans = [
+        BracketPlan(num_configs=(9, 3, 1), budgets=(1.0, 3.0, 9.0)),
+        BracketPlan(num_configs=(5, 1), budgets=(3.0, 9.0)),
+        BracketPlan(num_configs=(6, 2, 1), budgets=(1.0, 3.0, 9.0)),
+    ]
+    bucket_set = build_bucket_set(plans)
+    rng = np.random.default_rng(7)
+    members = []
+    for plan in plans:
+        bucket_idx, entry = bucket_set.lookup(
+            plan.num_configs, plan.budgets
+        )
+        vectors = rng.uniform(
+            -1.0, 1.0, size=(plan.num_configs[0], 2)
+        ).astype(np.float32)
+        members.append(
+            (bucket_set.buckets[bucket_idx], plan, entry, vectors)
+        )
+    return bucket_set, members
+
+
+class TestMegabatchParity:
+    def test_packed_equals_solo_bitwise(self):
+        """The acceptance bar: per member, packed (indices, losses) ==
+        solo dispatch, exactly."""
+        bucket_set, members = _parity_fixtures()
+        by_bucket = {}
+        for bucket, plan, entry, vectors in members:
+            by_bucket.setdefault(bucket, []).append(
+                PackEntry("t", vectors, plan, entry)
+            )
+        for bucket, entries in by_bucket.items():
+            runner = make_mega_runner(
+                branin_from_vector, bucket, pack_width=4
+            )
+            packed_out = runner.run_packed(entries, d=2)
+            solo_runner = make_bucketed_bracket_fn(
+                branin_from_vector, bucket
+            )
+            for e, packed_stages in zip(entries, packed_out):
+                solo_stages = solo_runner.run_member(
+                    e.vectors, e.plan, e.entry
+                )
+                assert len(solo_stages) == len(packed_stages)
+                for (si, sl), (pi, pl) in zip(
+                    solo_stages, packed_stages
+                ):
+                    np.testing.assert_array_equal(si, pi)
+                    np.testing.assert_array_equal(sl, pl)
+
+    def test_packed_compiles_at_most_one_program_per_bucket(self):
+        """Ledger-pinned: however many members/dispatches, megabatch
+        programs <= len(bucket_set)."""
+        led0 = (
+            get_compile_tracker()
+            .snapshot()["functions"]
+            .get("megabatch_bracket", {})
+            .get("compiles", 0)
+        )
+        bucket_set, members = _parity_fixtures()
+        for bucket, plan, entry, vectors in members:
+            runner = make_mega_runner(
+                branin_from_vector, bucket, pack_width=4
+            )
+            # two dispatches per bucket: same program both times
+            runner.run_packed(
+                [PackEntry("a", vectors, plan, entry)], d=2
+            )
+            runner.run_packed(
+                [PackEntry("b", vectors, plan, entry)] * 2, d=2
+            )
+        led1 = (
+            get_compile_tracker()
+            .snapshot()["functions"]
+            .get("megabatch_bracket", {})
+            .get("compiles", 0)
+        )
+        assert led1 - led0 <= len(bucket_set.buckets)
+
+    def test_pack_members_shapes_and_padding(self):
+        bucket_set, members = _parity_fixtures()
+        bucket, plan, entry, vectors = members[0]
+        packed, counts = pack_members(
+            [PackEntry("t", vectors, plan, entry)], bucket,
+            pack_width=4, d=2,
+        )
+        assert packed.shape == (4, bucket.widths[0], 2)
+        assert counts.shape == (4, bucket.depth)
+        # padding lanes are all-zero counts (pure pre-entry)
+        assert counts[1:].sum() == 0
+        with pytest.raises(ValueError):
+            pack_members(
+                [PackEntry("t", vectors, plan, entry)] * 5, bucket,
+                pack_width=4, d=2,
+            )
+
+    def test_crashed_rows_keep_parity(self):
+        """NaN (crashed) losses rank identically packed vs solo."""
+
+        def crashy(v, budget):
+            import jax.numpy as jnp
+
+            loss = branin_from_vector(v, budget)
+            return jnp.where(v[0] > 0.5, jnp.nan, loss)
+
+        plan = BracketPlan(num_configs=(9, 3, 1), budgets=(1.0, 3.0, 9.0))
+        bucket_set = build_bucket_set([plan])
+        bucket = bucket_set.buckets[0]
+        rng = np.random.default_rng(3)
+        vectors = rng.uniform(0.0, 1.0, size=(9, 2)).astype(np.float32)
+        runner = make_mega_runner(crashy, bucket, pack_width=2)
+        packed = runner.run_packed([PackEntry("t", vectors, plan, 0)], d=2)
+        solo = make_bucketed_bracket_fn(crashy, bucket).run_member(
+            vectors, plan, 0
+        )
+        for (si, sl), (pi, pl) in zip(solo, packed[0]):
+            np.testing.assert_array_equal(si, pi)
+            np.testing.assert_array_equal(sl, pl)
+
+
+# ------------------------------------------------------------- pool (fast)
+class TestTenantChurn:
+    def test_release_prunes_scheduler_and_weights(self):
+        """Under tenant churn the pool/scheduler must not grow per-tenant
+        state without bound: a fully released tenant's weight and round
+        state (deficit, arrival slot) are dropped; served_cost stays —
+        it is the cumulative fairness census."""
+        pool = ServePool(
+            _smoke_backend(), branin_space(seed=0), pack_window_s=0.0
+        )
+        for i in range(5):
+            tenant = f"churn{i}"
+            ex = pool.executor_for(tenant, weight=2.0)
+            # one scheduler round notes the tenant
+            pool.scheduler.select({tenant: [_Item(1)]}, capacity=4)
+            assert tenant in pool._weights
+            assert tenant in pool.scheduler._deficit
+            ex.shutdown()
+            assert tenant not in pool._weights
+            assert tenant not in pool.scheduler._deficit
+            assert tenant not in pool.scheduler._order
+            assert tenant in pool.scheduler.served_cost
+        assert pool.tenants() == []
+
+
+def _run_tenant(pool, tenant, seed, n_iterations=1, results=None,
+                max_budget=9):
+    from hpbandster_tpu.optimizers import BOHB
+
+    opt = BOHB(
+        configspace=branin_space(seed=seed),
+        run_id=f"serve-{tenant}-{seed}", tenant_id=tenant,
+        executor=pool.executor_for(tenant),
+        min_budget=1, max_budget=max_budget, eta=3, seed=seed,
+    )
+    res = opt.run(n_iterations=n_iterations)
+    opt.shutdown()
+    if results is not None:
+        results[tenant] = res
+    return res
+
+
+def _losses_by_config(result):
+    return {
+        (tuple(r.config_id), r.budget): r.loss
+        for r in result.get_all_runs()
+    }
+
+
+def test_serve_smoke():
+    """Tier-1 gate for the subsystem: two tenants, one bracket each,
+    megabatch machinery end-to-end — small enough for the fast lane."""
+    pool = ServePool(
+        _smoke_backend(), branin_space(seed=0), pack_window_s=0.05
+    )
+    results = {}
+    threads = [
+        threading.Thread(
+            target=_run_tenant, args=(pool, t, s, 1, results),
+            daemon=True,
+        )
+        for t, s in (("a", 1), ("b", 2))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert sorted(results) == ["a", "b"]
+    for res in results.values():
+        runs = res.get_all_runs()
+        assert len(runs) == 13  # 9 + 3 + 1 evaluations of one bracket
+        assert all(r.loss is not None for r in runs)
+
+
+def _smoke_backend():
+    from hpbandster_tpu.parallel import VmapBackend
+
+    return VmapBackend(branin_from_vector)
+
+
+class TestServePool:
+    def test_three_tenants_megabatch_and_fairness(self):
+        pool = ServePool(
+            _smoke_backend(), branin_space(seed=0), pack_window_s=0.05
+        )
+        m0 = obs.get_metrics().counter(
+            "serve.megabatch.packed_brackets"
+        ).value
+        results = {}
+        threads = [
+            threading.Thread(
+                target=_run_tenant, args=(pool, f"t{i}", 10 + i, 2, results),
+                daemon=True,
+            )
+            for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert sorted(results) == ["t0", "t1", "t2"]
+        for res in results.values():
+            assert len(res.get_all_runs()) == 19  # (9,3,1) + (5,1) waves
+        # same workload per tenant -> equal served cost
+        served = pool.scheduler.served_cost
+        assert max(served.values()) == min(served.values())
+        packed = obs.get_metrics().counter(
+            "serve.megabatch.packed_brackets"
+        ).value - m0
+        assert packed >= 2, "cross-tenant packing never engaged"
+
+    def test_packed_tenant_identical_to_solo_tenant(self):
+        """Cross-tenant bit-parity at the POOL level: tenant A's entire
+        sweep (losses per config per budget) is identical whether A runs
+        alone or packed with B and C."""
+        pool_solo = ServePool(
+            _smoke_backend(), branin_space(seed=0), pack_window_s=0.0
+        )
+        solo = _run_tenant(pool_solo, "A", seed=42, n_iterations=2)
+
+        pool_packed = ServePool(
+            _smoke_backend(), branin_space(seed=0), pack_window_s=0.05
+        )
+        results = {}
+        threads = [
+            threading.Thread(
+                target=_run_tenant,
+                args=(pool_packed, t, s, 2, results), daemon=True,
+            )
+            for t, s in (("A", 42), ("B", 43), ("C", 44))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert _losses_by_config(results["A"]) == _losses_by_config(solo)
+
+    def test_tenant_events_stamped_in_shared_journal(self, tmp_path):
+        journal = str(tmp_path / "serve.jsonl")
+        handle = obs.configure(journal_path=journal)
+        try:
+            pool = ServePool(
+                _smoke_backend(), branin_space(seed=0), pack_window_s=0.02
+            )
+            _run_tenant(pool, "acme", seed=5, n_iterations=1)
+        finally:
+            handle.close()
+        records = obs.read_journal(journal)
+        sampled = [
+            r for r in records if r.get("event") == "config_sampled"
+        ]
+        finished = [
+            r for r in records if r.get("event") == "job_finished"
+        ]
+        assert sampled and finished
+        assert all(r.get("tenant_id") == "acme" for r in sampled)
+        assert all(r.get("tenant_id") == "acme" for r in finished)
+        promos = [
+            r for r in records if r.get("event") == "promotion_decision"
+        ]
+        assert promos and all(
+            r.get("tenant_id") == "acme" for r in promos
+        )
+
+
+# --------------------------------------------------------------- sessions
+class TestSessionsAndWarmStart:
+    def test_spec_validation_reasons(self):
+        with pytest.raises(ValueError, match="unknown optimizer"):
+            SweepSpec(optimizer="gru")
+        with pytest.raises(ValueError, match="n_iterations"):
+            SweepSpec(n_iterations=0)
+        with pytest.raises(ValueError, match="unknown sweep spec"):
+            SweepSpec.from_dict({"objective": "mnist"})
+        spec = SweepSpec.from_dict({"optimizer": "random", "seed": 3})
+        assert spec.to_dict()["optimizer"] == "random"
+        assert spec.estimated_cost() > 0
+
+    def test_returning_tenant_gets_warm_model(self):
+        pool = ServePool(
+            _smoke_backend(), branin_space(seed=0), pack_window_s=0.0
+        )
+        store = TenantStore()
+        spec = SweepSpec(n_iterations=1, seed=7, max_budget=9)
+        m1 = TenantMaster(pool, "acme", spec, store=store)
+        m1.run()
+        assert store.warm("acme") is not None
+        assert store.session("acme").sweeps_completed == 1
+        # the second sweep replays the first Result into its generator:
+        # a WarmStartIteration is present and the KDE already has points
+        m2 = TenantMaster(pool, "acme", spec, store=store)
+        assert m2.optimizer.warmstart_iteration, (
+            "previous_result not replayed"
+        )
+        # warm_start=False opts out
+        cold = TenantMaster(
+            pool, "acme",
+            SweepSpec(n_iterations=1, seed=8, warm_start=False),
+            store=store,
+        )
+        assert not cold.optimizer.warmstart_iteration
+
+    def test_warm_models_are_per_tenant(self):
+        pool = ServePool(
+            _smoke_backend(), branin_space(seed=0), pack_window_s=0.0
+        )
+        store = TenantStore()
+        TenantMaster(
+            pool, "acme", SweepSpec(n_iterations=1, seed=7), store=store
+        ).run()
+        assert store.warm("acme") is not None
+        assert store.warm("other") is None
+
+
+# ----------------------------------------------------- frontend over sockets
+@pytest.mark.slow
+class TestFrontendEndToEnd:
+    def test_three_tenants_over_sockets_with_journal_reconciliation(
+        self, tmp_path
+    ):
+        """The full story: 3 tenants submit over TCP, sweeps run
+        concurrently against one pool, and afterwards each tenant's
+        slice of the SHARED journal reconciles with its own sweep
+        result."""
+        from hpbandster_tpu.obs.report import filter_tenant
+        from hpbandster_tpu.parallel.rpc import RPCProxy
+
+        journal = str(tmp_path / "serve.jsonl")
+        handle = obs.configure(journal_path=journal)
+        frontend = None
+        try:
+            pool = ServePool(
+                _smoke_backend(), branin_space(seed=0),
+                pack_window_s=0.05,
+            )
+            frontend = ServeFrontend(pool).start()
+            proxy = RPCProxy(frontend.uri, timeout=30)
+            sweep_ids = {}
+            for i, tenant in enumerate(("acme", "bob", "carol")):
+                reply = proxy.call(
+                    "submit_sweep", tenant=tenant,
+                    spec={"optimizer": "bohb", "n_iterations": 2,
+                          "max_budget": 9, "seed": 20 + i},
+                )
+                assert reply["accepted"], reply
+                sweep_ids[tenant] = reply["sweep_id"]
+            deadline = time.monotonic() + 120
+            states = {}
+            while time.monotonic() < deadline:
+                states = {
+                    t: proxy.call(
+                        "sweep_status", tenant=t, sweep_id=sid
+                    )["state"]
+                    for t, sid in sweep_ids.items()
+                }
+                if all(s == "done" for s in states.values()):
+                    break
+                time.sleep(0.1)
+            assert all(s == "done" for s in states.values()), states
+
+            for tenant, sid in sweep_ids.items():
+                result = proxy.call(
+                    "sweep_result", tenant=tenant, sweep_id=sid
+                )
+                assert result["incumbent"] is not None
+                assert result["configs_evaluated"] == 19
+        finally:
+            if frontend is not None:
+                frontend.shutdown()
+            handle.close()
+
+        records = obs.read_journal(journal)
+        for tenant in ("acme", "bob", "carol"):
+            mine = filter_tenant(records, tenant)
+            finished = [
+                r for r in mine
+                if r.get("event") in ("job_finished", "job_failed")
+                and "loss" in r
+            ]
+            assert len(finished) == 19, tenant
+            sampled = [
+                r for r in mine if r.get("event") == "config_sampled"
+            ]
+            assert len(sampled) == 14, tenant  # 9 + 5 fresh samples
+            # no cross-tenant bleed: every record names this tenant
+            assert all(r.get("tenant_id") == tenant for r in mine)
+
+    def test_admission_rejects_over_sockets(self):
+        from hpbandster_tpu.parallel.rpc import RPCProxy
+
+        pool = ServePool(
+            _smoke_backend(), branin_space(seed=0), pack_window_s=0.02
+        )
+        store = TenantStore(
+            default_quota=TenantQuota(max_active_sweeps=1)
+        )
+        frontend = ServeFrontend(pool, store=store).start()
+        try:
+            proxy = RPCProxy(frontend.uri, timeout=30)
+            spec = {"optimizer": "bohb", "n_iterations": 2,
+                    "max_budget": 9, "seed": 1}
+            first = proxy.call("submit_sweep", tenant="acme", spec=spec)
+            assert first["accepted"]
+            second = proxy.call("submit_sweep", tenant="acme", spec=spec)
+            assert not second["accepted"]
+            assert "max_active_sweeps" in second["reason"]
+            bad = proxy.call(
+                "submit_sweep", tenant="acme", spec={"optimizer": "gru"}
+            )
+            assert not bad["accepted"] and "unknown optimizer" in bad["reason"]
+            huge = proxy.call(
+                "submit_sweep", tenant="whale",
+                spec={"optimizer": "bohb", "n_iterations": 3,
+                      "min_budget": 1, "max_budget": 10_000_000},
+            )
+            assert not huge["accepted"]
+            assert "max_inflight_cost" in huge["reason"]
+            # foreign sweep ids are invisible
+            foreign = proxy.call(
+                "sweep_status", tenant="bob",
+                sweep_id=first["sweep_id"],
+            )
+            assert "unknown sweep" in foreign["error"]
+            quota = proxy.call("tenant_quota", tenant="acme")
+            assert quota["active_sweeps"] >= 0
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                st = proxy.call(
+                    "sweep_status", tenant="acme",
+                    sweep_id=first["sweep_id"],
+                )
+                if st["state"] != "running":
+                    break
+                time.sleep(0.1)
+            assert st["state"] == "done", st
+        finally:
+            frontend.shutdown()
+
+
+# ---------------------------------------------------- observability surface
+class TestServingObservability:
+    def test_export_tenant_label_round_trip(self):
+        from hpbandster_tpu.obs.export import (
+            metric_family,
+            parse_prometheus_text,
+            render_snapshot,
+        )
+
+        fam, labels = metric_family("serve.tenant.acme.configs_done")
+        assert fam == "hpbandster_serve_tenant_configs_done"
+        assert labels == {"tenant": "acme"}
+        # hostile tenant ids survive the escaping round trip
+        evil = 'a.b"x\nY\\z'
+        snap = {
+            "counters": {f"serve.tenant.{evil}.configs_done": 3},
+            "gauges": {}, "histograms": {},
+        }
+        text = render_snapshot(snap)
+        parsed = parse_prometheus_text(text)
+        fam_total = "hpbandster_serve_tenant_configs_done_total"
+        (labels, value), = parsed[fam_total]["samples"]
+        assert labels == {"tenant": evil} and value == 3.0
+
+    def test_endpoint_row_distills_tenants(self):
+        from hpbandster_tpu.obs.collector import _endpoint_row
+
+        row = _endpoint_row({
+            "component": "serve_frontend",
+            "metrics": {"counters": {
+                "serve.tenant.acme.configs_done": 19,
+                "serve.tenant.bob.configs_done": 38,
+                "serve.tenant.acme.rejected": 1,  # not a throughput
+                "rpc.client_calls": 5,
+            }},
+        })
+        assert row["tenants"] == {"acme": 19.0, "bob": 38.0}
+
+    def test_derive_fleet_fairness_ratio(self):
+        from hpbandster_tpu.obs.collector import derive_fleet
+
+        rows = {
+            "fe": {"ok": True, "tenants": {"a": 10.0, "b": 40.0}},
+            "w0": {"ok": True, "tenants": {"a": 10.0}},
+        }
+        fleet = derive_fleet(
+            rows, ok=2, stale=0, lost=0, churn_events=0
+        )
+        assert fleet["tenants"] == 2
+        assert fleet["tenant_throughput_ratio"] == 2.0  # 40 / (10+10)
+        assert fleet["tenants_starved"] == 0
+        # single tenant -> no ratio (no pair to compare)
+        fleet1 = derive_fleet(
+            {"fe": {"ok": True, "tenants": {"a": 5.0}}},
+            ok=1, stale=0, lost=0, churn_events=0,
+        )
+        assert fleet1["tenant_throughput_ratio"] is None
+
+    def test_derive_fleet_starved_tenant_is_counted(self):
+        """The ratio goes None over a zero denominator — permanent
+        starvation must surface through its own gauge instead."""
+        from hpbandster_tpu.obs.collector import derive_fleet
+
+        fleet = derive_fleet(
+            {"fe": {"ok": True, "tenants": {"a": 500.0, "b": 0.0}}},
+            ok=1, stale=0, lost=0, churn_events=0,
+        )
+        assert fleet["tenant_throughput_ratio"] is None
+        assert fleet["tenants_starved"] == 1
+        # warmup (nobody has progressed) is not starvation
+        cold = derive_fleet(
+            {"fe": {"ok": True, "tenants": {"a": 0.0, "b": 0.0}}},
+            ok=1, stale=0, lost=0, churn_events=0,
+        )
+        assert cold["tenants_starved"] == 0
+        # no tenants at all -> unmeasurable, not zero
+        none = derive_fleet(
+            {"fe": {"ok": True}}, ok=1, stale=0, lost=0, churn_events=0,
+        )
+        assert none["tenants_starved"] is None
+
+    def test_fleet_table_tenant_column_and_filter(self):
+        from hpbandster_tpu.obs.collector import format_fleet_table
+
+        sample = {
+            "fleet": {"endpoints": 2, "ok": 2, "stale": 0, "tenants": 2,
+                      "tenant_throughput_ratio": 1.5},
+            "endpoints": {
+                "fe": {"ok": True, "component": "serve_frontend",
+                       "uptime_s": 5.0,
+                       "tenants": {"acme": 19.0, "bob": 38.0}},
+                "w0": {"ok": True, "component": "worker",
+                       "uptime_s": 5.0, "tenants": {}},
+            },
+        }
+        text = format_fleet_table(sample)
+        assert "tenants=2" in text and "throughput_ratio=1.50" in text
+        filtered = format_fleet_table(sample, tenant="acme")
+        assert "fe" in filtered and "w0" not in filtered
+        assert "[filter: tenant=acme]" in filtered
+
+    def test_watch_snapshot_line_tenant_part(self):
+        from hpbandster_tpu.obs.summarize import _snapshot_status_line
+
+        snap = {
+            "component": "serve_frontend", "uptime_s": 1.0,
+            "in_flight": None,
+            "metrics": {"counters": {
+                "serve.tenant.acme.configs_done": 19,
+                "serve.tenant.bob.configs_done": 7,
+            }},
+        }
+        line = _snapshot_status_line(snap)
+        assert "tenants=2(acme:19,bob:7)" in line
+        line_t = _snapshot_status_line(snap, tenant="acme")
+        assert "tenant[acme]: configs_done=19" in line_t
+        # no serving counters -> no tenant part (byte-compat lines)
+        bare = _snapshot_status_line(
+            {"component": "worker", "uptime_s": 1.0, "in_flight": None,
+             "metrics": {"counters": {}}}
+        )
+        assert "tenant" not in bare
+
+    def test_report_tenant_filter(self):
+        from hpbandster_tpu.obs.report import filter_tenant
+
+        records = [
+            {"event": "job_finished", "tenant_id": "acme", "loss": 1.0},
+            {"event": "job_finished", "loss": 2.0},  # legacy record
+            {"event": "job_finished", "tenant_id": "bob", "loss": 3.0},
+        ]
+        assert len(filter_tenant(records, "acme")) == 1
+        # records without tenant_id belong to the default tenant
+        assert len(filter_tenant(records, "default")) == 1
+
+    def test_report_cli_tenant_flag(self, tmp_path, capsys):
+        from hpbandster_tpu.obs.__main__ import main as obs_main
+
+        journal = tmp_path / "mt.jsonl"
+        lines = [
+            {"event": "job_finished", "t_wall": 1.0, "t_mono": 1.0,
+             "config_id": [0, 0, 0], "budget": 1.0, "loss": 0.5,
+             "tenant_id": "acme"},
+            {"event": "job_finished", "t_wall": 2.0, "t_mono": 2.0,
+             "config_id": [0, 0, 1], "budget": 1.0, "loss": 0.25,
+             "tenant_id": "bob"},
+        ]
+        journal.write_text(
+            "".join(json.dumps(r) + "\n" for r in lines)
+        )
+        assert obs_main(
+            ["report", str(journal), "--tenant", "acme", "--json"]
+        ) == 0
+        rep = json.loads(capsys.readouterr().out)
+        traj = rep["incumbent_trajectory"]
+        assert len(traj) == 1 and traj[0]["loss"] == 0.5
