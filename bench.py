@@ -65,7 +65,7 @@ HEADLINE_BRACKETS = 27
 TIER_ORDER = (
     "cnn", "cnn_wide", "pallas", "resnet", "transformer", "fused10k",
     "chunked10k", "chunked_compile", "fused", "rpc", "batched", "teacher",
-    "multitenant", "obs_overhead", "runtime_overhead",
+    "multitenant", "chaos", "obs_overhead", "runtime_overhead",
     "collector_overhead", "report_100k",
 )
 
@@ -1264,6 +1264,171 @@ def bench_multitenant(n_tenants=16, repeats=3, max_budget=9, seed=0):
     }
 
 
+def bench_chaos(n_workers=4, n_iterations=3, seed=0, repeats=3,
+                kill_fraction=0.1, tick_s=0.25, outage_s=0.25,
+                compute_s_per_budget=0.02,
+                delay_rate=0.05, partition_rate=0.05, duplicate_rate=0.1):
+    """Elastic-fleet chaos tier: throughput retention and trajectory
+    consistency under ~10% worker churn (docs/fault_tolerance.md).
+
+    Paired seeded sweeps over the real host pool (nameserver +
+    dispatcher + ``n_workers`` socket workers): one undisturbed, one
+    with every worker behind a :class:`~hpbandster_tpu.parallel.chaos.
+    ChaosProxy` carrying seeded rate faults (delays, partitions,
+    duplicate deliveries — the exactly-once gate's diet) and a
+    ChaosMonkey killing each alive worker with probability
+    ``kill_fraction`` per ``tick_s`` with ``outage_s`` outages — the
+    defaults hold ~10% of the pool dead at any instant
+    ((0.1/0.25s)*0.25s). ``compute_s_per_budget`` paces the objective so
+    sweeps span enough monkey ticks for kills to land mid-compute (the
+    clean run pays the identical pacing, so retention stays a fair
+    pairing). The numbers that matter:
+
+    * ``throughput_retention`` — churn configs/s over clean configs/s
+      (paired seeds, medians): what 10% churn actually costs end to end
+      once requeues, backoff, and late-result joins are paid;
+    * ``trajectory_consistent`` — every paired run produced the
+      identical (config, budget, loss) set and incumbent (pure seeded
+      sampling, so any divergence is lost or double-counted work);
+    * the ``recovery.*`` counter deltas — how many requeues, duplicate
+      drops, and replays the churn actually provoked (a zero row means
+      the tier measured nothing).
+
+    Host-side sockets + a python objective: no device compiles, so the
+    tier regenerates on the CPU fallback path like the obs tiers.
+    """
+    from hpbandster_tpu import obs
+    from hpbandster_tpu.core.nameserver import NameServer
+    from hpbandster_tpu.core.worker import Worker
+    from hpbandster_tpu.optimizers import BOHB
+    from hpbandster_tpu.parallel.chaos import (
+        ChaosMonkey,
+        ChaosProxy,
+        ChaosSchedule,
+    )
+    from hpbandster_tpu.parallel.dispatcher import Dispatcher
+    from hpbandster_tpu.workloads.toys import branin_dict, branin_space
+
+    class ChurnWorker(Worker):
+        def compute(self, config_id, config, budget, working_directory):
+            # a budget-proportional cost so kills land mid-compute
+            time.sleep(compute_s_per_budget * float(budget))
+            return {"loss": branin_dict(config, budget), "info": {}}
+
+    def run_once(s, churn):
+        run_id = f"bench-chaos-{s}-{'churn' if churn else 'clean'}"
+        ns = NameServer(run_id=run_id, host="127.0.0.1", port=0)
+        host, port = ns.start()
+        proxies = {}
+        monkey = opt = None
+        # one seeded decision stream shared by every proxy: the fault
+        # sequence is a function of (s, call order), replayable like the
+        # chaos tests
+        schedule = ChaosSchedule(
+            seed=s, delay_rate=delay_rate, partition_rate=partition_rate,
+            duplicate_rate=duplicate_rate, delay_s=0.02,
+        ) if churn else None
+        try:
+            for i in range(n_workers):
+                w = ChurnWorker(
+                    run_id=run_id, nameserver=host, nameserver_port=port,
+                    id=i,
+                )
+                w.result_delivery_backoff = 0.02
+                w.result_delivery_backoff_cap = 0.2
+                w.run(background=True)
+                if churn:
+                    p = ChaosProxy(w._server.uri, schedule).start()
+                    p.interpose(host, port, w.worker_id)
+                    proxies[w.worker_id] = p
+            d = Dispatcher(
+                run_id=run_id, nameserver=host, nameserver_port=port,
+                ping_interval=0.1, discover_interval=0.1,
+                requeue_backoff=0.02, requeue_backoff_cap=0.2,
+            )
+            opt = BOHB(
+                configspace=branin_space(seed=s), run_id=run_id,
+                executor=d, min_budget=1, max_budget=9, eta=3, seed=s,
+                # pure seeded sampling: the trajectory is a function of
+                # the seed alone, so churn-vs-clean divergence can only
+                # mean lost or double-counted work
+                min_points_in_model=10_000,
+            )
+            if churn:
+                monkey = ChaosMonkey(
+                    proxies, seed=s, interval_s=tick_s,
+                    kill_fraction=kill_fraction, outage_s=outage_s,
+                    max_dead=n_workers - 1,
+                ).start()
+            t0 = time.perf_counter()
+            res = opt.run(n_iterations=n_iterations, min_n_workers=n_workers)
+            dt = time.perf_counter() - t0
+            runs = {
+                (r.config_id, r.budget): r.loss for r in res.get_all_runs()
+            }
+            kills = (
+                len([e for e in monkey.log if e[2] == "kill"])
+                if monkey is not None else 0
+            )
+            return runs, res.get_incumbent_id(), len(runs) / dt, kills
+        finally:
+            # cleanup runs on the FAILURE path too: a sweep that dies
+            # under unlucky churn must not leak its monkey thread or its
+            # worker pool into the remaining repeats' measurements
+            if monkey is not None:
+                monkey.stop()
+            if opt is not None:
+                opt.shutdown(shutdown_workers=True)
+            for p in proxies.values():
+                p.shutdown()
+            ns.shutdown()
+
+    reg = obs.get_metrics()
+    recovery_keys = (
+        "recovery.requeues", "recovery.duplicates_dropped",
+        "recovery.replayed_results", "recovery.quarantines",
+        "chaos.faults",
+    )
+    before = {k: reg.counter(k).value for k in recovery_keys}
+    clean_rates, churn_rates, kills_per_run = [], [], []
+    consistent = True
+    for i in range(repeats):
+        s = seed + i
+        runs_c, inc_c, rate_c, _ = run_once(s, churn=False)
+        runs_x, inc_x, rate_x, kills = run_once(s, churn=True)
+        clean_rates.append(rate_c)
+        churn_rates.append(rate_x)
+        kills_per_run.append(kills)
+        if runs_x != runs_c or inc_x != inc_c:
+            consistent = False
+    deltas = {
+        k.split(".", 1)[-1]: reg.counter(k).value - before[k]
+        for k in recovery_keys
+    }
+    clean = _summary(clean_rates)
+    churn = _summary(churn_rates)
+    return {
+        "n_workers": n_workers,
+        "n_iterations": n_iterations,
+        "median": churn["median"],
+        "iqr": churn["iqr"],
+        "runs_configs_per_s": churn["runs_configs_per_s"],
+        "clean": clean,
+        "throughput_retention": round(churn["median"] / clean["median"], 3)
+        if clean["median"] else None,
+        "trajectory_consistent": consistent,
+        "kills_per_run": kills_per_run,
+        "recovery": deltas,
+        "churn_knobs": {
+            "kill_fraction_per_tick": kill_fraction,
+            "tick_s": tick_s, "outage_s": outage_s,
+            "expected_dead_fraction": round(
+                kill_fraction / tick_s * outage_s, 3
+            ),
+        },
+    }
+
+
 def bench_report_100k(n_events=100_000, seed=0):
     """Report-CLI throughput over a synthetic ``n_events``-line journal.
 
@@ -1403,6 +1568,10 @@ TIER_BUDGETS = {
     # demand must NOT compile per tenant or per pack size, which is
     # exactly the regression a blown ceiling would catch
     "multitenant":     {"max_compiles": 32, "max_transfer_mb": 64},
+    # elastic/chaos tier: host sockets + a python objective — the
+    # recovery machinery must cost (near) zero device work; a compile
+    # appearing here means chaos plumbing leaked onto the device path
+    "chaos":           {"max_compiles": 4,  "max_transfer_mb": 8},
 }
 
 
@@ -1586,6 +1755,9 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
         multitenant = emit("multitenant", _run_tier(
             errors, "multitenant", bench_multitenant,
             n_tenants=4, repeats=repeats))
+        chaos = emit("chaos", _run_tier(
+            errors, "chaos", bench_chaos,
+            n_workers=2, n_iterations=1, repeats=repeats))
         obs_overhead = emit("obs_overhead", _run_tier(
             errors, "obs_overhead", bench_obs_overhead, repeats=repeats))
         runtime_overhead = emit("runtime_overhead", _run_tier(
@@ -1744,6 +1916,15 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
                            repeats=repeats))
             if selected("multitenant") else dict(NOT_SELECTED)
         )
+        # elastic-fleet tier: host sockets + a python objective like the
+        # rpc tier, so it measures anywhere (fallback runs included) —
+        # the throughput-retention claim in docs/fault_tolerance.md must
+        # regenerate without a chip
+        chaos = (
+            emit("chaos",
+                 _run_tier(errors, "chaos", bench_chaos, repeats=repeats))
+            if selected("chaos") else dict(NOT_SELECTED)
+        )
         # backend-independent (the obs layer is host-side either way) and
         # seconds-scale on CPU, so it measures even on the fallback path —
         # the overhead claim in docs/observability.md regenerates anywhere
@@ -1863,6 +2044,7 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
             "chunked_compile_static_vs_dynamic": chunked,
             "chunked10k_at_scale_36_brackets_1_729": chunked10k,
             "multitenant_serving_16_tenants": multitenant,
+            "chaos_churn_10pct": chaos,
             "obs_overhead_no_sink": obs_overhead,
             "runtime_overhead_tracked_jit": runtime_overhead,
             "collector_overhead_fleet_poll": collector_overhead,

@@ -15,5 +15,6 @@ from hpbandster_tpu.analysis.rules import (  # noqa: F401
     obs_emit,
     obs_reserved,
     prng,
+    retry,
     wallclock,
 )

@@ -36,6 +36,17 @@ class Job:
         #: tells a job's whole story including redispatch. Never serialized
         #: into ``timestamps``/result schema.
         self.trace: Optional[Any] = None
+        #: exactly-once identity (core/recovery.py idempotency_key) minted
+        #: beside the trace: stable across requeues and redispatches, so
+        #: every copy of this job's result resolves to one key
+        self.idem_key: Optional[str] = None
+        #: elastic-recovery bookkeeping (parallel/dispatcher.py): how many
+        #: times this job was orphaned by a dying worker and requeued, and
+        #: the earliest monotonic instant it may redispatch (capped
+        #: exponential backoff — a crashing config must not hot-loop
+        #: through the surviving pool)
+        self.requeue_count: int = 0
+        self.not_before_mono: float = 0.0
 
     def time_it(self, which_time: str) -> "Job":
         """Record a wall-clock timestamp ('submitted' | 'started' | 'finished')."""

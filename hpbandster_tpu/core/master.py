@@ -53,6 +53,7 @@ class Master:
         shutdown_workers: bool = True,
         checkpoint_path: Optional[str] = None,
         checkpoint_interval: float = 30.0,
+        wal_path: Optional[str] = None,
         collector: Any = None,
         tenant_id: Optional[str] = None,
     ):
@@ -87,6 +88,22 @@ class Master:
         self.checkpoint_path = checkpoint_path
         self.checkpoint_interval = float(checkpoint_interval)
         self._last_checkpoint_mono = 0.0
+
+        # write-ahead result journal (core/recovery.py): every terminal
+        # result is journaled BEFORE bracket bookkeeping consumes it, so
+        # checkpoint + WAL tail together lose no work across a crash —
+        # resume via Master.resume(checkpoint_path, wal_path). The WAL
+        # truncates after each successful checkpoint (the checkpoint now
+        # carries that state), keeping it a tail, not a second history.
+        self.wal_path = wal_path
+        self._wal = None
+        if wal_path is not None:
+            from hpbandster_tpu.core.recovery import ResultWAL
+
+            # run_id-stamped: a wal_path reused by a DIFFERENT run must
+            # not have this run's journaling suppressed (or its replay
+            # polluted) by the leftover records
+            self._wal = ResultWAL(wal_path, run_id=run_id)
 
         # re-entrant: batched executors fire job_callback synchronously from
         # inside flush(), which runs under this same condition
@@ -251,6 +268,19 @@ class Master:
         # audit events emitted by process_results() carry the stamp
         with obs.use_tenant(self.tenant_id), self.thread_cond:
             self.num_running_jobs -= 1
+            if self._wal is not None:
+                # write-ahead: on disk before any in-memory consumption,
+                # so a crash after this line re-joins the result from the
+                # WAL instead of re-running the evaluation
+                from hpbandster_tpu.core.recovery import idempotency_key
+
+                budget = job.kwargs.get("budget", 0.0)
+                self._wal.append(
+                    getattr(job, "idem_key", None)
+                    or idempotency_key(job.id, budget),
+                    job.id, budget, job.result, job.exception,
+                    job.timestamps,
+                )
             if self.result_logger is not None:
                 self.result_logger(job)
             self.iterations[job.id[0]].register_result(job)
@@ -284,6 +314,11 @@ class Master:
         # master -> dispatcher -> worker -> result round-trip (obs/trace.py)
         job.trace = obs.new_trace(self.run_id)
         job.tenant_id = self.tenant_id
+        # exactly-once identity (core/recovery.py): requeues, late dead
+        # letters, and delivery retries all resolve to this one key
+        from hpbandster_tpu.core.recovery import idempotency_key
+
+        job.idem_key = idempotency_key(config_id, budget)
         job.time_it("submitted")
         with obs.use_tenant(self.tenant_id), obs.use_trace(job.trace):
             obs.emit(obs.JOB_SUBMITTED, config_id=list(config_id), budget=budget)
@@ -424,6 +459,8 @@ class Master:
         if self.health_server is not None:
             self.health_server.shutdown()
             self.health_server = None
+        if self._wal is not None:
+            self._wal.close()
         self.executor.shutdown(shutdown_workers)
 
     # ------------------------------------------------------------ checkpoint
@@ -432,7 +469,14 @@ class Master:
         from hpbandster_tpu.core.checkpoint import save_checkpoint
 
         t0 = time.monotonic()
-        save_checkpoint(self, path)
+        # the lock covers snapshot AND WAL truncation: a result ingested
+        # between the two would be in neither the checkpoint nor the WAL
+        # (thread_cond is re-entrant — the auto-checkpoint path already
+        # holds it)
+        with self.thread_cond:
+            save_checkpoint(self, path)
+            if self._wal is not None:
+                self._wal.truncate()
         self._last_checkpoint_mono = time.monotonic()
         obs.emit(
             obs.CHECKPOINT_WRITTEN,
@@ -447,3 +491,15 @@ class Master:
         from hpbandster_tpu.core.checkpoint import load_checkpoint
 
         load_checkpoint(self, path)
+
+    def resume(
+        self, checkpoint_path: str, wal_path: Optional[str] = None
+    ) -> Dict[str, int]:
+        """Crash-restart: restore ``checkpoint_path``, then replay the
+        write-ahead result journal tail so results that arrived after the
+        last checkpoint join back without re-running (core/recovery.py).
+        Returns the replay stats; ``run(n_iterations=<same total>)``
+        then re-dispatches only unfinished configs."""
+        from hpbandster_tpu.core.recovery import resume_master
+
+        return resume_master(self, checkpoint_path, wal_path)

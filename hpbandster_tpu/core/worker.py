@@ -308,21 +308,41 @@ class Worker:
             self._deliver_result(
                 callback_uri, config_id,
                 {"result": result, "exception": exception},
+                budget=job_kwargs.get("budget"),
             )
 
     def _deliver_result(
-        self, callback_uri: str, config_id: Any, payload: Dict[str, Any]
+        self,
+        callback_uri: str,
+        config_id: Any,
+        payload: Dict[str, Any],
+        budget: Any = None,
     ) -> bool:
         """Push the result to the dispatcher, retrying transient failures
         with capped exponential backoff — a single failed RPC must not
-        strand a result the worker already paid to compute."""
+        strand a result the worker already paid to compute.
+
+        Every attempt carries the job's idempotency key
+        (``core/recovery.py``): a retry racing a slow ack of the first
+        attempt used to deliver TWICE (the second copy dead-lettered or,
+        worse, double-registered after a requeue) — the dispatcher's
+        exactly-once gate now recognizes the key and acks the duplicate
+        without re-ingesting it.
+        """
+        from hpbandster_tpu.core.recovery import idempotency_key
+
+        key = (
+            idempotency_key(config_id, budget)
+            if isinstance(budget, (int, float)) else None
+        )
         t0 = time.monotonic()
         delay = self.result_delivery_backoff
         attempts = max(int(self.result_delivery_attempts), 1)
         for attempt in range(1, attempts + 1):
             try:
                 RPCProxy(callback_uri, timeout=30).call(
-                    "register_result", id=list(config_id), result=payload
+                    "register_result", id=list(config_id), result=payload,
+                    key=key,
                 )
             # broad on purpose (matches the pre-retry behavior): a
             # serialization TypeError must be logged and counted like any

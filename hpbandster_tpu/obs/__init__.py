@@ -91,21 +91,26 @@ from hpbandster_tpu.obs.audit import (  # noqa: F401
 from hpbandster_tpu.obs.events import (  # noqa: F401
     ALERT,
     BRACKET_PROMOTION,
+    CHAOS_FAULT,
     CHECKPOINT_WRITTEN,
     CONFIG_SAMPLED,
+    DUPLICATE_RESULT,
     EVENT_TYPES,
     FLEET_SAMPLE,
     JOB_FAILED,
     JOB_FINISHED,
+    JOB_REQUEUED,
     JOB_STARTED,
     JOB_SUBMITTED,
     KDE_REFIT,
     PROMOTION_DECISION,
     RESULT_DELIVERED,
+    RESULT_REPLAYED,
     RPC_RETRY,
     UNKNOWN_RESULT,
     WORKER_DISCOVERED,
     WORKER_DROPPED,
+    WORKER_QUARANTINED,
     XLA_COMPILE,
     Event,
     EventBus,
@@ -192,6 +197,8 @@ __all__ = [
     "CHECKPOINT_WRITTEN", "UNKNOWN_RESULT",
     "CONFIG_SAMPLED", "PROMOTION_DECISION", "ALERT", "XLA_COMPILE",
     "FLEET_SAMPLE",
+    "JOB_REQUEUED", "RESULT_REPLAYED", "DUPLICATE_RESULT",
+    "WORKER_QUARANTINED", "CHAOS_FAULT",
 ]
 
 

@@ -59,6 +59,11 @@ __all__ = [
     "ALERT",
     "XLA_COMPILE",
     "FLEET_SAMPLE",
+    "JOB_REQUEUED",
+    "RESULT_REPLAYED",
+    "DUPLICATE_RESULT",
+    "WORKER_QUARANTINED",
+    "CHAOS_FAULT",
 ]
 
 logger = logging.getLogger("hpbandster_tpu.obs")
@@ -90,6 +95,21 @@ XLA_COMPILE = "xla_compile"
 #: one fleet-collector poll round (obs/collector.py): derived fleet
 #: gauges — endpoint census, device balance, churn and trend rates
 FLEET_SAMPLE = "fleet_sample"
+#: recovery vocabulary (core/recovery.py, docs/fault_tolerance.md): a
+#: dispatcher re-queued an orphaned job after its worker died ...
+JOB_REQUEUED = "job_requeued"
+#: ... a previously-stranded result (WAL record or dead letter) joined
+#: back into a live run exactly once ...
+RESULT_REPLAYED = "result_replayed"
+#: ... a second delivery of an already-ingested result was recognized by
+#: its idempotency key and dropped (the exactly-once gate) ...
+DUPLICATE_RESULT = "duplicate_result"
+#: ... and a flapping worker was quarantined: dropped AND banned from
+#: rediscovery until the quarantine expires
+WORKER_QUARANTINED = "worker_quarantined"
+#: one injected fault from the chaos harness (parallel/chaos.py):
+#: kind in {kill, delay, drop, duplicate}
+CHAOS_FAULT = "chaos_fault"
 
 #: the core vocabulary (docs/observability.md "Event schema"). emit() also
 #: accepts names outside this set — subsystems may add their own (span
@@ -99,6 +119,8 @@ EVENT_TYPES = frozenset({
     WORKER_DISCOVERED, WORKER_DROPPED, BRACKET_PROMOTION, KDE_REFIT,
     RPC_RETRY, RESULT_DELIVERED, CHECKPOINT_WRITTEN, UNKNOWN_RESULT,
     CONFIG_SAMPLED, PROMOTION_DECISION, ALERT, XLA_COMPILE, FLEET_SAMPLE,
+    JOB_REQUEUED, RESULT_REPLAYED, DUPLICATE_RESULT, WORKER_QUARANTINED,
+    CHAOS_FAULT,
 })
 
 #: process-wide kill switch (hpbandster_tpu.obs.set_enabled)

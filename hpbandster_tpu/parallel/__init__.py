@@ -18,6 +18,11 @@ from hpbandster_tpu.parallel.batched_worker import (  # noqa: F401
     RPCBatchBackend,
     TPUBatchedWorker,
 )
+from hpbandster_tpu.parallel.chaos import (  # noqa: F401
+    ChaosMonkey,
+    ChaosProxy,
+    ChaosSchedule,
+)
 from hpbandster_tpu.parallel.dispatcher import Dispatcher  # noqa: F401
 from hpbandster_tpu.parallel.rpc import (  # noqa: F401
     CommunicationError,

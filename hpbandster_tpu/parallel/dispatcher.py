@@ -17,6 +17,20 @@ carries it to the worker), ``job_started`` reports ``queue_wait_s`` /
 pressure, the ping loop doubles as the fleet heartbeat collector
 (``obs_snapshot`` per worker, ``dispatcher.workers_alive`` / last-seen-age
 gauges), and the dispatcher's own RPC server answers ``obs_snapshot``.
+
+Elastic recovery (docs/fault_tolerance.md): result ingestion is
+exactly-once — every copy of a result (late arrivals from presumed-dead
+workers, worker delivery retries racing a slow ack, chaos-duplicated
+frames) resolves through the job's idempotency key, the first copy joins
+the run, later copies are counted and acked. A late result for a
+requeued-but-not-yet-redispatched job claims it straight from the
+waiting queue (work is never redone just because the ack was lost), and
+dead letters are keyed so a resubmitted job joins its stranded payload
+back on submit. Requeues carry a capped-backoff retry budget; exhausting
+it fails the job instead of hot-looping it through the pool. When an
+attached anomaly detector fires ``worker_flapping``, the named worker is
+quarantined — dropped AND banned from rediscovery until the quarantine
+expires — instead of being rediscovered into the same crash loop.
 """
 
 from __future__ import annotations
@@ -28,8 +42,12 @@ from typing import Any, Callable, Dict, List, Optional
 
 from hpbandster_tpu import obs
 from hpbandster_tpu.core.job import Job
+from hpbandster_tpu.core.recovery import (
+    DeadLetterBox,
+    ExactlyOnceGate,
+    idempotency_key,
+)
 from hpbandster_tpu.obs.health import HealthEndpoint
-from hpbandster_tpu.obs.journal import RingBuffer
 from hpbandster_tpu.parallel.rpc import (
     CommunicationError,
     RPCError,
@@ -100,6 +118,11 @@ class Dispatcher:
         discover_interval: float = 1.0,
         logger: Optional[logging.Logger] = None,
         anomaly: Any = None,
+        dead_letter_capacity: int = 64,
+        max_job_requeues: int = 8,
+        requeue_backoff: float = 0.25,
+        requeue_backoff_cap: float = 8.0,
+        quarantine_s: float = 60.0,
     ):
         self.run_id = run_id
         self.nameserver_uri = format_uri(nameserver, nameserver_port)
@@ -115,8 +138,27 @@ class Dispatcher:
 
         #: dead-letter trail for results that arrive for unknown jobs (the
         #: worker already computed them — the payload must not vanish):
-        #: counted in obs metrics AND retained here for post-mortems
-        self.dead_letters = RingBuffer(capacity=64)
+        #: counted in obs metrics, retained for post-mortems, and KEYED so
+        #: a resubmitted job can claim its stranded payload on submit.
+        #: Capacity is a knob; overflow counts dispatcher.dead_letters_dropped
+        #: instead of silently discarding computed work
+        self.dead_letters = DeadLetterBox(capacity=dead_letter_capacity)
+        #: exactly-once result ingestion: first copy of each idempotency
+        #: key joins the run, every later copy is a counted duplicate
+        self._gate = ExactlyOnceGate()
+
+        #: requeue retry budget (capped exponential backoff): a job whose
+        #: workers keep dying redispatches at most this many times before
+        #: it fails with an exception result instead of looping forever
+        self.max_job_requeues = int(max_job_requeues)
+        self.requeue_backoff = float(requeue_backoff)
+        self.requeue_backoff_cap = float(requeue_backoff_cap)
+
+        #: quarantine ledger: worker name -> monotonic expiry. Quarantined
+        #: names are skipped by discovery until expiry, so a flapping host
+        #: cannot rejoin the pool faster than it crashes out of it
+        self.quarantine_s = float(quarantine_s)
+        self._quarantined: Dict[str, float] = {}
 
         self._cond = threading.Condition()
         self._shutdown_event = threading.Event()
@@ -131,6 +173,7 @@ class Dispatcher:
         #: (pass AnomalyRules to tune thresholds, True for defaults)
         self.anomaly_detector = None
         self._anomaly_detach: Optional[Callable[[], None]] = None
+        self._alert_detach: Optional[Callable[[], None]] = None
         if anomaly:
             from hpbandster_tpu.obs.anomaly import AnomalyDetector, AnomalyRules
 
@@ -153,6 +196,10 @@ class Dispatcher:
         self._server.register("ping", lambda: "pong")
         if self.anomaly_detector is not None:
             self._anomaly_detach = obs.get_bus().subscribe(self.anomaly_detector)
+            # close the loop: the detector's alerts were previously only
+            # counted — now worker_flapping quarantines the worker it
+            # names (drop + rediscovery ban + requeue of its job)
+            self._alert_detach = obs.get_bus().subscribe(self._on_alert)
         # fleet health: the dispatcher introspects like any other process
         HealthEndpoint(
             component="dispatcher",
@@ -175,10 +222,44 @@ class Dispatcher:
             self._threads.append(t)
 
     def submit_job(self, job: Job) -> None:
+        if job.idem_key is None:
+            job.idem_key = idempotency_key(job.id, job.kwargs.get("budget", 0.0))
+        # exactly-once dead-letter replay: a resubmitted job (crash-restart
+        # re-dispatching its unfinished configs) whose result already
+        # arrived — and was dead-lettered because nobody knew the job —
+        # joins that payload back instead of re-running the evaluation
+        letter = self.dead_letters.take(job.idem_key)
+        if letter is not None and not self._gate.admit(job.idem_key):
+            # the key was already ingested once — the letter is a stale
+            # duplicate copy, not recoverable work
+            obs.get_metrics().counter("recovery.duplicates_dropped").inc()
+            letter = None
+        if letter is not None:
+            self.logger.info(
+                "job %s joined its dead-lettered result on submit", job.id
+            )
+            obs.emit(
+                obs.RESULT_REPLAYED,
+                config_id=list(job.id), budget=job.kwargs.get("budget"),
+                source="dead_letter", key=job.idem_key,
+            )
+            obs.get_metrics().counter("recovery.replayed_results").inc()
+            self._deliver(job, letter.get("result") or {})
+            return
         with self._cond:
             self.waiting_jobs.append(job)
             self._update_queue_gauges()
             self._cond.notify_all()
+
+    def _deliver(self, job: Job, payload: Dict[str, Any]) -> None:
+        """Hand a terminal payload to the master's callback (shared by the
+        normal ingest path, dead-letter joins, and budget exhaustion)."""
+        if "started" not in job.timestamps:
+            job.time_it("started")
+        job.time_it("finished")
+        job.result = payload.get("result")
+        job.exception = payload.get("exception")
+        self._new_result_callback(job)
 
     def _update_queue_gauges(self) -> None:
         # callers hold self._cond; the gauges' own registry lock nests
@@ -194,6 +275,7 @@ class Dispatcher:
                 "running": [list(cid) for cid in self.running_jobs],
                 "waiting": len(self.waiting_jobs),
                 "workers": len(self.workers),
+                "quarantined": sorted(self._quarantined),
             }
 
     def number_of_workers(self) -> int:
@@ -216,6 +298,9 @@ class Dispatcher:
         if self._anomaly_detach is not None:
             self._anomaly_detach()
             self._anomaly_detach = None
+        if self._alert_detach is not None:
+            self._alert_detach()
+            self._alert_detach = None
         if self._server is not None:
             self._server.shutdown()
             self._server = None
@@ -237,9 +322,20 @@ class Dispatcher:
     def _sync_workers(self, listing: Dict[str, str]) -> None:
         with self._cond:
             known = set(self.workers)
+            now = time.monotonic()
+            # expire served quarantines; anything still listed is banned
+            self._quarantined = {
+                n: t for n, t in self._quarantined.items() if t > now
+            }
+            quarantined = set(self._quarantined)
         added = 0
         for name, uri in listing.items():
             if name in known:
+                continue
+            if name in quarantined:
+                self.logger.debug(
+                    "worker %s still quarantined; not rediscovering", name
+                )
                 continue
             w = WorkerProxy(name, uri)
             if not w.is_alive():
@@ -260,18 +356,74 @@ class Dispatcher:
                 self._cond.notify_all()
             self._new_worker_callback(n)
 
+    # ------------------------------------------------- bounded retry budget
+    def _stamp_requeue(self, job: Job) -> bool:
+        """Consume one requeue attempt: True = still within budget (the
+        job now carries its capped-exponential-backoff eligibility
+        instant), False = budget exhausted (the caller must fail the job
+        via :meth:`_fail_exhausted`). ONE implementation for the
+        worker-death and dispatch-failure paths — the retry contract
+        (docs/fault_tolerance.md) must not be able to diverge."""
+        job.requeue_count += 1
+        if job.requeue_count > self.max_job_requeues:
+            return False
+        job.not_before_mono = time.monotonic() + min(
+            self.requeue_backoff * (2.0 ** (job.requeue_count - 1)),
+            self.requeue_backoff_cap,
+        )
+        return True
+
+    def _note_requeued(self, job: Job, worker: str, reason: str) -> None:
+        obs.emit(
+            obs.JOB_REQUEUED,
+            config_id=list(job.id), worker=worker, reason=reason,
+            attempt=job.requeue_count, max_attempts=self.max_job_requeues,
+        )
+        obs.get_metrics().counter("recovery.requeues").inc()
+
+    def _fail_exhausted(self, job: Job, worker: str, reason: str) -> None:
+        """Terminal failure once the retry budget is gone — through the
+        exactly-once gate, so a genuinely-late result arriving after the
+        failure reads as a duplicate, never a double registration."""
+        obs.get_metrics().counter("recovery.requeue_budget_exhausted").inc()
+        self.logger.error(
+            "job %s exhausted its requeue budget (%d attempts); failing",
+            job.id, job.requeue_count,
+        )
+        if self._gate.admit(job.idem_key or ""):
+            self._deliver(job, {
+                "result": None,
+                "exception": (
+                    f"requeue budget exhausted: {job.requeue_count} "
+                    f"dispatch attempts all failed "
+                    f"(last: {worker}, {reason})"
+                ),
+            })
+
     def _drop_worker(self, name: str, reason: str) -> None:
+        failed_job: Optional[Job] = None
         with self._cond:
             w = self.workers.pop(name, None)
             if w is None:
                 return
             job = self.running_jobs.pop(tuple(w.runs_job), None) if w.runs_job else None
             if job is not None:
-                # elastic failure handling: requeue the orphaned job
-                self.logger.warning(
-                    "worker %s vanished (%s); requeueing job %s", name, reason, job.id
-                )
-                self.waiting_jobs.insert(0, job)
+                if self._stamp_requeue(job):
+                    # elastic failure handling: requeue the orphaned job
+                    # under capped backoff (a config that kills its
+                    # workers must not hot-loop the survivors)
+                    self.logger.warning(
+                        "worker %s vanished (%s); requeueing job %s "
+                        "(attempt %d/%d)",
+                        name, reason, job.id,
+                        job.requeue_count, self.max_job_requeues,
+                    )
+                    self.waiting_jobs.insert(0, job)
+                else:
+                    # retry budget exhausted: fail the job instead of
+                    # cycling it through the pool forever — the bracket
+                    # records it crashed-as-worst and moves on
+                    failed_job = job
                 self._update_queue_gauges()
             else:
                 self.logger.info("worker %s dropped (%s)", name, reason)
@@ -279,12 +431,21 @@ class Dispatcher:
         obs.emit(
             obs.WORKER_DROPPED,
             worker=name, reason=reason,
-            requeued=list(job.id) if job is not None else None,
+            # only report a requeue that actually happened: a job failed
+            # for exhausting its retry budget was NOT requeued
+            requeued=(
+                list(job.id)
+                if job is not None and failed_job is None else None
+            ),
         )
         obs.get_metrics().counter("dispatcher.workers_dropped").inc()
+        if job is not None and failed_job is None:
+            self._note_requeued(job, name, reason)
         # a departed worker's last-seen-age gauge must leave with it, or
         # elastic churn leaks stale frozen metrics without bound
         obs.get_metrics().remove(f"dispatcher.worker_last_seen_age_s.{name}")
+        if failed_job is not None:
+            self._fail_exhausted(failed_job, name, reason)
 
     def _ping_loop(self) -> None:
         """Heartbeat collector: detect dying workers (requeue their jobs)
@@ -332,10 +493,18 @@ class Dispatcher:
                 if self.waiting_jobs:
                     worker = self._idle_worker()
                     if worker is not None:
-                        job = self.waiting_jobs.pop(0)
-                        worker.runs_job = job.id
-                        self.running_jobs[tuple(job.id)] = job
-                        self._update_queue_gauges()
+                        # first ELIGIBLE job: requeued jobs sit out their
+                        # capped backoff window while fresh jobs behind
+                        # them keep the pool busy
+                        now = time.monotonic()
+                        for i, candidate in enumerate(self.waiting_jobs):
+                            if candidate.not_before_mono <= now:
+                                job = self.waiting_jobs.pop(i)
+                                break
+                        if job is not None:
+                            worker.runs_job = job.id
+                            self.running_jobs[tuple(job.id)] = job
+                            self._update_queue_gauges()
                 if job is None:
                     self._cond.wait(0.2)
                     continue
@@ -377,48 +546,176 @@ class Dispatcher:
                     worker.runs_job = None
                 if isinstance(e, CommunicationError):
                     self._drop_worker(worker.name, reason="dispatch failed")
-                with self._cond:
-                    self.waiting_jobs.insert(0, job)
-                    self._update_queue_gauges()
-                    self._cond.notify_all()
+                # same bounded-retry contract as a worker death: a job
+                # whose dispatch keeps failing (e.g. a kwargs payload the
+                # server rejects every time) must back off and eventually
+                # fail, not hot-loop through the next idle worker
+                self._requeue_or_fail(
+                    job, worker.name, reason=f"dispatch failed: {e!r}"
+                )
+
+    def _requeue_or_fail(self, job: Job, worker: str, reason: str) -> None:
+        """Bounded requeue for a job whose dispatch attempt failed: the
+        same budget/backoff contract as the worker-death path in
+        ``_drop_worker`` (shared via ``_stamp_requeue``/``_fail_exhausted``)."""
+        if not self._stamp_requeue(job):
+            self._fail_exhausted(job, worker, reason)
+            return
+        with self._cond:
+            self.waiting_jobs.insert(0, job)
+            self._update_queue_gauges()
+            self._cond.notify_all()
+        self._note_requeued(job, worker, reason)
 
     # ---------------------------------------------------------- result inflow
-    def _rpc_register_result(self, id: Any, result: Dict[str, Any]) -> bool:
+    def _rpc_register_result(
+        self, id: Any, result: Dict[str, Any], key: Optional[str] = None
+    ) -> bool:
+        """Exactly-once result ingestion.
+
+        ``key`` is the job's idempotency key, stamped by the worker
+        (``core/worker.py`` sends it on every delivery attempt; older
+        workers omit it and the dispatcher recovers it from its own job
+        records). Resolution order:
+
+        1. job running under this cid AND matching this key -> gate-admit,
+           deliver (duplicates counted + ACKED so the delivering worker
+           stops retrying);
+        2. matching job requeued and still WAITING -> claim it from the
+           queue and deliver (a late result from a presumed-dead worker
+           means the work is done — never redo it);
+        3. no matching job, key already ingested -> duplicate, acked;
+        4. no matching job, unknown key -> dead-letter (keyed, bounded,
+           overflow counted), awaiting a resubmit to join back.
+
+        The claim is KEY-aware, not just cid-aware: a config re-runs at
+        every rung with the same cid, so a late duplicate of its
+        budget-1 delivery must never claim (and discard) its live
+        budget-3 job — a cross-budget copy falls through to 3/4 instead.
+        A keyless delivery (old worker) matches by cid alone, the
+        pre-key behavior.
+        """
+
+        def matches(candidate: Job) -> bool:
+            return (
+                key is None
+                or candidate.idem_key is None
+                or key == candidate.idem_key
+            )
+
         cid = tuple(id)
+        duplicate = False
         with self._cond:
-            job = self.running_jobs.pop(cid, None)
+            job = self.running_jobs.get(cid)
+            if job is not None and matches(job):
+                del self.running_jobs[cid]
+            else:
+                job = None
+                # a requeued-but-not-redispatched job can still claim its
+                # late result: the evaluation is DONE, drop it from the
+                # queue instead of re-running it
+                for i, waiting in enumerate(self.waiting_jobs):
+                    if tuple(waiting.id) == cid and matches(waiting):
+                        job = self.waiting_jobs.pop(i)
+                        break
             if job is not None:
+                if job.idem_key is None:
+                    job.idem_key = idempotency_key(
+                        job.id, job.kwargs.get("budget", 0.0)
+                    )
+                # admit under the SAME lock as the claim: a concurrent
+                # copy of this delivery either still sees the job (and
+                # queues behind this claim) or sees the admitted key —
+                # never the neither-window that would dead-letter an
+                # already-ingested payload as a phantom unknown result
+                admitted = self._gate.admit(
+                    key if key is not None else job.idem_key
+                )
                 for w in self.workers.values():
                     if w.runs_job is not None and tuple(w.runs_job) == cid:
                         w.runs_job = None
                 self._update_queue_gauges()
                 self._cond.notify_all()
-        if job is None:
-            # dead-letter, don't drop: a worker computed this (e.g. a late
-            # result landing after its worker was declared dead, requeued,
-            # and re-discovered) — count it and retain the payload for
-            # post-mortems instead of losing data silently. Outside the
-            # lock: sinks do I/O, and a journal write must not stall the
-            # job-runner loop on self._cond. The delivering worker's trace
-            # and tenant (the _obs envelope on this very RPC) are retained
-            # with it, so the dead letter joins back onto the merged
-            # timeline — and a multi-tenant post-mortem can attribute the
-            # orphaned payload to the sweep that paid for it.
-            tc = obs.current_trace()
-            self.dead_letters.append({
-                "config_id": list(cid), "result": result,
-                "trace_id": tc.trace_id if tc is not None else None,
-                "tenant_id": obs.current_tenant() or obs.DEFAULT_TENANT,
-            })
-            obs.get_metrics().counter("dispatcher.unknown_results").inc()
-            obs.emit(obs.UNKNOWN_RESULT, config_id=list(cid))
-            self.logger.warning(
-                "result for unknown job %s dead-lettered (%d retained)",
-                cid, len(self.dead_letters),
+            else:
+                # late retry of an already-ingested delivery (e.g. the
+                # ack of the first copy was lost): duplicate, acked,
+                # never re-joined. Checked under _cond for the same
+                # race-closure as the admit above.
+                duplicate = key is not None and self._gate.seen(key)
+        if job is not None:
+            if not admitted:
+                self._note_duplicate(cid, key or job.idem_key)
+                return True  # ACK: the result is ingested, stop retrying
+            self._deliver(job, result)
+            return True
+        if duplicate:
+            self._note_duplicate(cid, key)
+            return True
+        # dead-letter, don't drop: a worker computed this (e.g. a late
+        # result landing after its worker was declared dead, requeued,
+        # and re-discovered) — count it and retain the payload for
+        # post-mortems AND replay: a later submit of the same key joins
+        # it back exactly once. Outside the lock: sinks do I/O, and a
+        # journal write must not stall the job-runner loop on self._cond.
+        # The delivering worker's trace and tenant (the _obs envelope on
+        # this very RPC) are retained with it, so the dead letter joins
+        # back onto the merged timeline — and a multi-tenant post-mortem
+        # can attribute the orphaned payload to the sweep that paid for it.
+        tc = obs.current_trace()
+        self.dead_letters.append({
+            "config_id": list(cid), "result": result, "key": key,
+            "trace_id": tc.trace_id if tc is not None else None,
+            "tenant_id": obs.current_tenant() or obs.DEFAULT_TENANT,
+        })
+        obs.get_metrics().counter("dispatcher.unknown_results").inc()
+        obs.emit(obs.UNKNOWN_RESULT, config_id=list(cid))
+        self.logger.warning(
+            "result for unknown job %s dead-lettered (%d retained)",
+            cid, len(self.dead_letters),
+        )
+        return False
+
+    def _note_duplicate(self, cid: Any, key: Optional[str]) -> None:
+        obs.get_metrics().counter("recovery.duplicates_dropped").inc()
+        obs.emit(obs.DUPLICATE_RESULT, config_id=list(cid), key=key)
+        self.logger.info("duplicate result for %s (key %s) dropped", cid, key)
+
+    # ------------------------------------------------------------ quarantine
+    def _on_alert(self, event: Any) -> None:
+        """Bus sink closing the anomaly loop: a ``worker_flapping`` alert
+        quarantines the worker it names (this dispatcher's prefix only —
+        a foreign journal's worker ids are not ours to act on)."""
+        try:
+            if getattr(event, "name", None) != "alert":
+                return
+            fields = getattr(event, "fields", None) or {}
+            if fields.get("rule") != "worker_flapping":
+                return
+            subject = str(fields.get("subject") or "")
+            if subject.startswith(self.prefix):
+                self.quarantine_worker(subject, reason="worker_flapping")
+        except Exception:
+            # bus sinks must never raise (events.py contract)
+            self.logger.exception("alert-driven quarantine failed")
+
+    def quarantine_worker(
+        self, name: str, reason: str, duration_s: Optional[float] = None
+    ) -> None:
+        """Drop ``name`` (its in-flight job requeues under the normal
+        retry budget) and ban it from rediscovery for ``duration_s``
+        (default ``quarantine_s``) — a flapping host must sit out, not
+        cycle through discover/crash/requeue."""
+        duration = self.quarantine_s if duration_s is None else float(duration_s)
+        with self._cond:
+            already = name in self._quarantined
+            self._quarantined[name] = time.monotonic() + duration
+        self._drop_worker(name, reason=f"quarantined ({reason})")
+        if not already:
+            obs.emit(
+                obs.WORKER_QUARANTINED,
+                worker=name, reason=reason, duration_s=duration,
             )
-            return False
-        job.time_it("finished")
-        job.result = result.get("result")
-        job.exception = result.get("exception")
-        self._new_result_callback(job)
-        return True
+            obs.get_metrics().counter("recovery.quarantines").inc()
+            self.logger.warning(
+                "worker %s quarantined for %.1fs (%s)", name, duration, reason
+            )

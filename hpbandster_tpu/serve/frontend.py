@@ -56,12 +56,16 @@ class ServeFrontend:
         host: str = "127.0.0.1",
         port: int = 0,
         store: Optional[TenantStore] = None,
+        persist_dir: Optional[str] = None,
         logger: Optional[logging.Logger] = None,
     ):
         from hpbandster_tpu.parallel.rpc import RPCServer
 
         self.pool = pool
-        self.store = store or TenantStore()
+        # persist_dir without an explicit store: tenant warm state (the
+        # KDE each tenant paid to learn) survives frontend restarts —
+        # see TenantStore and docs/fault_tolerance.md "Serving tier"
+        self.store = store or TenantStore(persist_dir=persist_dir)
         self.logger = logger or logging.getLogger("hpbandster_tpu.serve")
         self._lock = threading.Lock()
         #: serializes admission-check -> registration: the RPC server is

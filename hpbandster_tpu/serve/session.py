@@ -26,6 +26,11 @@ Everything here is host-side bookkeeping — no jax imports.
 
 from __future__ import annotations
 
+import hashlib
+import logging
+import os
+import pickle
+import re
 import threading
 import time
 import uuid
@@ -138,13 +143,51 @@ class TenantSession:
         )
 
 
-class TenantStore:
-    """Thread-safe tenant registry; sessions are created on first touch."""
+#: tenant-state persistence format (``TenantStore(persist_dir=)``)
+_PERSIST_VERSION = 1
+_persist_log = logging.getLogger("hpbandster_tpu.serve")
 
-    def __init__(self, default_quota: Optional[TenantQuota] = None):
+
+def _tenant_filename(tenant_id: str) -> str:
+    """Collision-safe on-disk name for a SELF-REPORTED tenant id: a
+    sanitized readable prefix plus a hash tail (two ids that sanitize
+    identically — ``a/b`` vs ``a_b`` — must not share a file)."""
+    safe = re.sub(r"[^A-Za-z0-9._-]", "_", tenant_id)[:48] or "tenant"
+    digest = hashlib.sha256(tenant_id.encode("utf-8")).hexdigest()[:12]
+    return f"{safe}-{digest}.pkl"
+
+
+class TenantStore:
+    """Thread-safe tenant registry; sessions are created on first touch.
+
+    With ``persist_dir`` the store survives frontend restarts: each
+    tenant's warm :class:`~hpbandster_tpu.core.result.Result` (and its
+    completed-sweep count) is written to its own file after every
+    finished sweep, and a returning tenant's first touch after a restart
+    reloads it — the KDE warm start the tenant paid for does not die
+    with the process (docs/fault_tolerance.md "Serving tier"). A
+    corrupt or unreadable file degrades to a cold start with a warning,
+    never an error: persistence is a recovery aid, not a gate.
+    """
+
+    def __init__(
+        self,
+        default_quota: Optional[TenantQuota] = None,
+        persist_dir: Optional[str] = None,
+    ):
         self._lock = threading.Lock()
         self._sessions: Dict[str, TenantSession] = {}
         self.default_quota = default_quota
+        self.persist_dir = persist_dir
+        # disk writes serialize on their own lock (never the session
+        # lock), and each tenant's last-written sweep count guards
+        # against two concurrent finishes landing out of order — the
+        # NEWER snapshot must win the file, whatever the thread
+        # interleaving
+        self._persist_lock = threading.Lock()
+        self._persisted_version: Dict[str, int] = {}
+        if persist_dir is not None:
+            os.makedirs(persist_dir, exist_ok=True)
 
     def session(self, tenant_id: str) -> TenantSession:
         with self._lock:
@@ -155,8 +198,96 @@ class TenantStore:
                     if self.default_quota is not None else None
                 )
                 s = TenantSession(tenant_id, quota=quota)
+                self._load_persisted(s)
                 self._sessions[str(tenant_id)] = s
             return s
+
+    # ---------------------------------------------------------- persistence
+    def _tenant_path(self, tenant_id: str) -> Optional[str]:
+        if self.persist_dir is None:
+            return None
+        return os.path.join(self.persist_dir, _tenant_filename(tenant_id))
+
+    def _load_persisted(self, session: TenantSession) -> None:
+        """First-touch rehydration (caller holds the store lock — read
+        I/O under it is deliberate: it happens ONCE per tenant lifetime,
+        and a session must never become visible half-rehydrated)."""
+        path = self._tenant_path(session.tenant_id)
+        if path is None or not os.path.exists(path):
+            return
+        try:
+            with open(path, "rb") as fh:
+                state = pickle.load(fh)
+            if state.get("format_version") != _PERSIST_VERSION:
+                raise ValueError(
+                    f"unsupported tenant-state version "
+                    f"{state.get('format_version')}"
+                )
+            session.warm_result = state.get("warm_result")
+            session.sweeps_completed = int(state.get("sweeps_completed", 0))
+        except Exception as e:
+            # cold start beats a bricked tenant: admission and sweeps work
+            # without the warm model, so log and move on
+            _persist_log.warning(
+                "could not load persisted state for tenant %r from %s "
+                "(%r); starting cold", session.tenant_id, path, e,
+            )
+            return
+        from hpbandster_tpu import obs
+
+        obs.get_metrics().counter("serve.tenant_state_loads").inc()
+        _persist_log.info(
+            "tenant %r warm state reloaded (%d completed sweep(s))",
+            session.tenant_id, session.sweeps_completed,
+        )
+
+    def _snapshot_state(self, session: TenantSession) -> Dict[str, Any]:
+        """Cheap state capture (caller holds the store lock); the
+        pickling and disk write happen OUTSIDE it (`_write_state`) — one
+        tenant's slow disk must not stall every other tenant's
+        session/admission/warm call."""
+        return {
+            "format_version": _PERSIST_VERSION,
+            "tenant_id": session.tenant_id,
+            "warm_result": session.warm_result,
+            "sweeps_completed": session.sweeps_completed,
+            "saved_wall": time.time(),
+        }
+
+    def _write_state(self, tenant_id: str, state: Dict[str, Any]) -> None:
+        """Persist a snapshot (no store lock held). Atomic tmp+rename: a
+        crash mid-write leaves the previous state, never a torn file.
+        Stale snapshots are skipped: when two sweeps for one tenant
+        finish concurrently, the write racing in LAST must not regress
+        the file to the earlier state."""
+        path = self._tenant_path(tenant_id)
+        if path is None:
+            return
+        # the version check and the write share the persist lock: a
+        # skipped-as-stale verdict is only safe if no newer write can be
+        # overtaken after it — serializing writes here costs nothing the
+        # session lock's callers can feel
+        with self._persist_lock:
+            version = int(state.get("sweeps_completed", 0))
+            if version <= self._persisted_version.get(tenant_id, -1):
+                return
+            try:
+                tmp = f"{path}.tmp"
+                with open(tmp, "wb") as fh:
+                    pickle.dump(state, fh)
+                os.replace(tmp, path)
+            except Exception as e:
+                # an unwritable disk must not fail the sweep that just
+                # finished — the result is still served from memory
+                _persist_log.warning(
+                    "could not persist tenant %r state to %s (%r)",
+                    tenant_id, path, e,
+                )
+                return
+            self._persisted_version[tenant_id] = version
+        from hpbandster_tpu import obs
+
+        obs.get_metrics().counter("serve.tenant_state_saves").inc()
 
     def tenants(self) -> List[str]:
         with self._lock:
@@ -192,16 +323,35 @@ class TenantStore:
             )
 
     def remember_result(self, tenant_id: str, result: Any) -> None:
-        """Keep ``result`` as the tenant's warm model for its next sweep."""
+        """Keep ``result`` as the tenant's warm model for its next sweep
+        (written through to ``persist_dir`` when the store persists)."""
         s = self.session(tenant_id)
         with self._lock:
             s.warm_result = result
             s.sweeps_completed += 1
+            state = (
+                self._snapshot_state(s)
+                if self.persist_dir is not None else None
+            )
+        if state is not None:
+            self._write_state(s.tenant_id, state)
 
     def warm(self, tenant_id: str) -> Any:
         with self._lock:
             s = self._sessions.get(str(tenant_id))
-            return s.warm_result if s is not None else None
+            if s is not None:
+                return s.warm_result
+        # persisting store: first touch after a restart rehydrates the
+        # session before the read — but ONLY for tenants that actually
+        # left state behind. Tenant ids are self-reported: a read probe
+        # of an unknown id must not mint (and permanently register) a
+        # phantom session.
+        path = self._tenant_path(tenant_id)
+        if path is None or not os.path.exists(path):
+            return None
+        s = self.session(tenant_id)
+        with self._lock:
+            return s.warm_result
 
 
 class TenantMaster:

@@ -1,0 +1,68 @@
+"""Known-bad fixture for the retry-backoff rule: every ``while True:``
+marked ``# BAD`` retries a failing call with no cap, deadline, or any
+other way for the failure path to exit."""
+
+import time
+
+
+def classic_unbounded_retry(call):
+    while True:  # BAD
+        try:
+            return call()
+        except ConnectionError:
+            time.sleep(0.5)
+
+
+def swallow_and_spin(deliver, payload):
+    delay = 0.1
+    while True:  # BAD
+        try:
+            deliver(payload)
+            break
+        except Exception:
+            time.sleep(delay)
+            delay = delay * 2
+
+
+def counted_but_never_checked(call):
+    attempts = 0
+    while True:  # BAD
+        try:
+            call()
+            break
+        except OSError:
+            attempts += 1  # counted, but nothing ever acts on it
+            time.sleep(0.1 * attempts)
+
+
+def success_exit_hides_in_if(poll):
+    while True:  # BAD
+        try:
+            value = poll()
+            if value is not None:
+                return value
+        except TimeoutError:
+            continue
+
+
+def nested_loop_break_is_not_an_exit(calls):
+    while True:  # BAD
+        try:
+            for c in calls:
+                c()
+            break
+        except RuntimeError:
+            for _ in range(3):
+                break  # exits the for, not the retry loop
+            time.sleep(1.0)
+
+
+def exit_only_in_nested_def(call):
+    while True:  # BAD
+        try:
+            call()
+            break
+        except ValueError:
+            def bail():
+                return None  # returns from bail(), not the loop
+            bail()

@@ -1,0 +1,85 @@
+"""Known-good fixture for the retry-backoff rule: every retry here is
+bounded (attempt cap, monotonic deadline, re-raise after a budget check,
+or a non-constant loop condition) or is not a retry loop at all."""
+
+import time
+
+
+def capped_for_loop(call, attempts=4):
+    delay = 0.5
+    for attempt in range(attempts):  # bounded by construction
+        try:
+            return call()
+        except ConnectionError:
+            if attempt == attempts - 1:
+                raise
+            time.sleep(delay)
+            delay = min(delay * 2, 8.0)
+
+
+def monotonic_deadline(call, budget_s=30.0):
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:  # non-constant condition
+        try:
+            return call()
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError("retry budget exhausted")
+
+
+def handler_reraises_after_cap(call, cap=5):
+    attempts = 0
+    while True:
+        try:
+            return call()
+        except RuntimeError:
+            attempts += 1
+            if attempts >= cap:
+                raise
+            time.sleep(0.1)
+
+
+def post_try_budget_check(call, cap=5):
+    attempts = 0
+    while True:
+        try:
+            call()
+            break
+        except ValueError:
+            pass
+        attempts += 1
+        if attempts >= cap:
+            raise RuntimeError("gave up")
+
+
+def failure_path_breaks(call):
+    while True:
+        try:
+            call()
+        except KeyError:
+            break  # failure exits the loop: bounded at one failure
+        time.sleep(0.1)
+
+
+def shutdown_flag_loop(event, call):
+    while not event.is_set():  # non-constant condition: the flag ends it
+        try:
+            call()
+        except OSError:
+            time.sleep(0.05)
+
+
+def plain_event_loop(queue, handle):
+    while True:  # no try/except: not a retry loop (frame-read style)
+        item = queue.get()
+        if item is None:
+            return
+        handle(item)
+
+
+def suppressed_forever_server(accept, serve):
+    while True:  # graftlint: disable=retry-backoff — accept loop, lives as long as the process
+        try:
+            serve(accept())
+        except OSError:
+            time.sleep(0.02)

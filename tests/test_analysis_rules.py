@@ -26,6 +26,7 @@ CASES = [
     ("jit-in-loop", "jit_loop_bad.py", "jit_loop_good.py"),
     ("jit-donation", "donation_bad.py", "donation_good.py"),
     ("wallclock-duration", "wallclock_bad.py", "wallclock_good.py"),
+    ("retry-backoff", "retry_bad.py", "retry_good.py"),
 ]
 
 

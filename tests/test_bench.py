@@ -446,6 +446,12 @@ def _stub_tiers(monkeypatch, calls):
         lambda **kw: calls.setdefault("multitenant", True)
         and {"n_tenants": 16, "median": 100.0, "iqr": [90.0, 110.0],
              "packing_efficiency": 1.2, "p95_queue_wait_s": 0.05})
+    monkeypatch.setattr(
+        bench, "bench_chaos",
+        lambda **kw: calls.setdefault("chaos", True)
+        and {"n_workers": 4, "median": 50.0, "iqr": [45.0, 55.0],
+             "throughput_retention": 0.8, "trajectory_consistent": True,
+             "recovery": {"requeues": 3}})
 
 
 class TestFallbackContract:
@@ -597,7 +603,7 @@ class TestTierSelection:
         assert set(bench.TIER_ORDER) == {
             "cnn", "cnn_wide", "pallas", "resnet", "transformer",
             "fused10k", "chunked10k", "chunked_compile", "fused", "rpc",
-            "batched", "teacher", "multitenant", "obs_overhead",
+            "batched", "teacher", "multitenant", "chaos", "obs_overhead",
             "runtime_overhead", "collector_overhead", "report_100k",
         }
 

@@ -170,6 +170,70 @@ class TestFusedCheckpoint:
             if r.loss is not None
         )
 
+    def test_checkpoint_under_active_sweep_restores_warm_state_bitwise(
+        self, tmp_path, monkeypatch
+    ):
+        """The elastic arc's missing case: the at-rest tests checkpoint a
+        finished run; here the mid-run checkpoint (written after chunk 0
+        while the SAME run keeps mutating its warm buffers and RNG for
+        chunk 1) is captured live, restored into a fresh optimizer, and
+        must carry the exact warm_state — resuming bit-identically even
+        though the donor process ran on past the snapshot (no aliasing
+        into live buffers)."""
+        import os
+        import pickle
+        import shutil
+
+        from hpbandster_tpu.optimizers import FusedBOHB
+
+        path = str(tmp_path / "live.pkl")
+        mid = str(tmp_path / "mid.pkl")
+        orig = FusedBOHB.save_checkpoint
+
+        def capture_first(self, p):
+            orig(self, p)
+            if not os.path.exists(mid):
+                shutil.copy(p, mid)
+
+        monkeypatch.setattr(FusedBOHB, "save_checkpoint", capture_first)
+        ref = make_fused()
+        res_ref = ref.run(
+            n_iterations=4, chunk_brackets=2, checkpoint_path=path
+        )
+        ref.shutdown()
+
+        with open(mid, "rb") as fh:
+            state = pickle.load(fh)
+        # the captured file really is the ACTIVE-sweep boundary: 2 of 4
+        # brackets done, warm observations present for every rung so far
+        assert [s["HPB_iter"] for s in state["iterations"]] == [0, 1]
+        assert state["warm_v"] and state["warm_l"]
+
+        resumed = make_fused()
+        resumed.load_checkpoint(mid)
+        # warm_state restored bit-for-bit from the mid-flight snapshot —
+        # the donor mutating its buffers for chunk 1 must not have leaked
+        # into what the checkpoint holds
+        assert set(resumed._warm_v) == {
+            float(b) for b in state["warm_v"]
+        }
+        for b, v in state["warm_v"].items():
+            assert np.array_equal(resumed._warm_v[float(b)], v)
+        for b, l in state["warm_l"].items():
+            assert np.array_equal(resumed._warm_l[float(b)], l)
+        assert resumed.rng.bit_generator.state == state["rng_state"]
+
+        res = resumed.run(n_iterations=4, chunk_brackets=2)
+        resumed.shutdown()
+        got = sorted(
+            (r.config_id, r.budget, r.loss) for r in res.get_all_runs()
+        )
+        want = sorted(
+            (r.config_id, r.budget, r.loss) for r in res_ref.get_all_runs()
+        )
+        assert got == want  # bitwise: same warm data, same RNG draws
+        assert res.get_incumbent_id() == res_ref.get_incumbent_id()
+
     def test_shape_mismatch_rejected(self, tmp_path):
         from hpbandster_tpu.optimizers import FusedBOHB
 

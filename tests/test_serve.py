@@ -16,6 +16,7 @@ Acceptance bars (ISSUE 8):
 """
 
 import json
+import os
 import threading
 import time
 
@@ -733,6 +734,91 @@ class TestSessionsAndWarmStart:
         ).run()
         assert store.warm("acme") is not None
         assert store.warm("other") is None
+
+
+class TestTenantPersistence:
+    """Per-tenant warm-state persistence (docs/fault_tolerance.md
+    "Serving tier"): the KDE a tenant paid to learn survives frontend
+    restarts."""
+
+    def test_warm_state_survives_store_restart(self, tmp_path):
+        persist = str(tmp_path / "tenants")
+        pool = ServePool(
+            _smoke_backend(), branin_space(seed=0), pack_window_s=0.0
+        )
+        store = TenantStore(persist_dir=persist)
+        TenantMaster(
+            pool, "acme", SweepSpec(n_iterations=1, seed=7), store=store
+        ).run()
+        assert store.warm("acme") is not None
+        del store  # the frontend process dies
+
+        reborn = TenantStore(persist_dir=persist)
+        assert reborn.warm("acme") is not None
+        assert reborn.session("acme").sweeps_completed == 1
+        assert reborn.warm("other") is None
+        # the reloaded Result actually warm-starts the next sweep
+        m = TenantMaster(
+            pool, "acme", SweepSpec(n_iterations=1, seed=8), store=reborn
+        )
+        assert m.optimizer.warmstart_iteration, (
+            "persisted result not replayed into the new sweep"
+        )
+        m.optimizer.shutdown()
+
+    def test_corrupt_persisted_state_degrades_to_cold(self, tmp_path):
+        from hpbandster_tpu.serve.session import _tenant_filename
+
+        persist = str(tmp_path / "tenants")
+        os.makedirs(persist)
+        with open(os.path.join(persist, _tenant_filename("acme")), "wb") as fh:
+            fh.write(b"not a pickle at all")
+        store = TenantStore(persist_dir=persist)
+        # cold start, not a bricked tenant
+        assert store.warm("acme") is None
+        assert store.session("acme").sweeps_completed == 0
+
+    def test_self_reported_ids_cannot_collide_on_disk(self):
+        from hpbandster_tpu.serve.session import _tenant_filename
+
+        a, b = _tenant_filename("a/b"), _tenant_filename("a_b")
+        assert a != b  # sanitization alone would alias these
+        assert "/" not in a and "\\" not in a
+        # hostile ids stay inside the directory
+        evil = _tenant_filename("../../etc/passwd")
+        assert "/" not in evil
+
+    def test_warm_probe_of_unknown_id_mints_no_session(self, tmp_path):
+        """Tenant ids are self-reported: a read probe of an id with no
+        persisted state must not register a phantom session (unbounded
+        growth from read-only queries)."""
+        store = TenantStore(persist_dir=str(tmp_path / "tenants"))
+        assert store.warm("never-seen") is None
+        assert store.warm("another-probe") is None
+        assert store.tenants() == []
+
+    def test_memory_only_store_writes_nothing(self, tmp_path):
+        pool = ServePool(
+            _smoke_backend(), branin_space(seed=0), pack_window_s=0.0
+        )
+        store = TenantStore()  # no persist_dir
+        TenantMaster(
+            pool, "acme", SweepSpec(n_iterations=1, seed=7), store=store
+        ).run()
+        assert store.warm("acme") is not None
+        assert list(tmp_path.iterdir()) == []
+
+    def test_frontend_persist_dir_passthrough(self, tmp_path):
+        persist = str(tmp_path / "tenants")
+        pool = ServePool(
+            _smoke_backend(), branin_space(seed=0), pack_window_s=0.0
+        )
+        f = ServeFrontend(pool, persist_dir=persist).start()
+        try:
+            assert f.store.persist_dir == persist
+            assert os.path.isdir(persist)
+        finally:
+            f.shutdown(timeout=1.0)
 
 
 # ----------------------------------------------------- frontend over sockets

@@ -231,7 +231,7 @@ class TestWorkerResultDelivery:
         state = {"calls": 0, "delivered": []}
         srv = RPCServer("127.0.0.1", 0)
 
-        def register_result(id, result):
+        def register_result(id, result, key=None):
             state["calls"] += 1
             if state["calls"] <= fail_first:
                 raise RuntimeError(f"synthetic failure {state['calls']}")
