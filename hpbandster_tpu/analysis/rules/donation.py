@@ -20,6 +20,13 @@ comment, see docs/perf_notes.md "Buffer donation contract"). A ``**kwargs``
 splat passes too (the decision lives wherever the dict is built — static
 analysis cannot see into it).
 
+``pjit`` call sites are sharded BY CONSTRUCTION (the mesh-sharded sweep
+arc's pjit/NamedSharding pattern): a call resolving to
+``jax.experimental.pjit.pjit`` is flagged even without a spelled
+sharding kwarg; a bare ``pjit`` name (no jax import in the module — a
+local helper) is only flagged when it passes a sharding kwarg, like the
+other bare wrapper names.
+
 Not flagged:
 
 * unsharded jit sites — small/host-shaped programs where the donation
@@ -44,6 +51,23 @@ _JIT_WRAPPERS = {
     "tracked_jit",
     "hpbandster_tpu.obs.tracked_jit",
     "hpbandster_tpu.obs.runtime.tracked_jit",
+    # bare pjit: only flagged when it spells a sharding kwarg (the
+    # unconditional pjit check lives in _SHARDED_WRAPPERS and requires
+    # the fully-qualified jax import)
+    "pjit",
+}
+
+#: wrappers that are sharded BY CONSTRUCTION — a pjit site is a
+#: large-buffer program boundary whether or not it spells a sharding
+#: kwarg (the mesh-sharded sweep arc's pjit/NamedSharding pattern), so
+#: the donation stance is demanded unconditionally there. Fully-qualified
+#: ONLY: a bare `pjit` that resolves to no jax import is a module-local
+#: name (ImportMap returns the head unchanged then) — flagging it would
+#: report any local helper named pjit as a jax boundary. A bare-named
+#: genuine pjit call still gets the kwarg-triggered check via
+#: _JIT_WRAPPERS below.
+_SHARDED_WRAPPERS = {
+    "jax.experimental.pjit.pjit",
 }
 
 _SHARDING_KWARGS = {"in_shardings", "out_shardings"}
@@ -61,8 +85,12 @@ class JitDonationRule(Rule):
     )
 
     def check(self, module: SourceModule) -> List[Finding]:
-        # sound prefilter: a flaggable call must spell a sharding kwarg
-        if not any(t in module.text for t in _SHARDING_KWARGS):
+        # sound prefilter: a flaggable call must spell a sharding kwarg or
+        # name a sharded-by-construction wrapper
+        if not (
+            any(t in module.text for t in _SHARDING_KWARGS)
+            or "pjit" in module.text
+        ):
             return []
         imports = import_map_for(module)
         findings: List[Finding] = []
@@ -70,10 +98,11 @@ class JitDonationRule(Rule):
             if not isinstance(node, ast.Call):
                 continue
             resolved = imports.resolve(node.func) or ""
-            if resolved not in _JIT_WRAPPERS:
+            always_sharded = resolved in _SHARDED_WRAPPERS
+            if resolved not in _JIT_WRAPPERS and not always_sharded:
                 continue
             kw_names = {kw.arg for kw in node.keywords if kw.arg is not None}
-            if not (kw_names & _SHARDING_KWARGS):
+            if not always_sharded and not (kw_names & _SHARDING_KWARGS):
                 continue
             if kw_names & _DONATION_KWARGS:
                 continue
@@ -81,11 +110,15 @@ class JitDonationRule(Rule):
                 # **splat: the decision may live in the dict — unanalyzable,
                 # treated as an explicit stance
                 continue
+            via = (
+                f"passes {sorted(kw_names & _SHARDING_KWARGS)}"
+                if kw_names & _SHARDING_KWARGS
+                else "is a pjit boundary (sharded by construction)"
+            )
             findings.append(
                 self.finding(
                     module, node,
-                    f"{resolved}(...) passes "
-                    f"{sorted(kw_names & _SHARDING_KWARGS)} but no "
+                    f"{resolved}(...) {via} but no "
                     "donate_argnums/donate_argnames — sharded call sites "
                     "move large buffers; state the donation decision "
                     "explicitly (donate_argnums=() with a rationale "

@@ -27,6 +27,15 @@ interval, folds each round into
   - ``fleet.device_mem_utilization`` — bytes in use / limit, fleet-wide
   - ``fleet.device_mem_skew`` — (max - min)/max over per-device busy
     bytes: the balance number the mesh-sharding arc reads
+  - ``fleet.device_compute_skew`` — worst per-endpoint (max - min)/max
+    over per-device sharded-sweep config counts
+    (``sweep.device.<i>.configs``, published by
+    ``parallel.multihost.publish_device_balance``; counts are only
+    comparable within one sweep, so endpoints are judged separately and
+    the fleet gauge is the worst of them): the compute-balance sibling
+    of the memory skew — on an SPMD mesh all devices step in lockstep,
+    so row-count imbalance IS step-time imbalance. The gauge describes
+    each endpoint's MOST RECENT sharded sweep
   - ``fleet.worker_churn_per_min`` — worker drops + endpoint losses
   - ``fleet.queue_depth_trend_per_min`` — signed queue drain/growth rate
   - ``fleet.compile_rate_per_min`` — fresh XLA compiles across the fleet
@@ -165,6 +174,18 @@ def _endpoint_row(snap: Dict[str, Any]) -> Dict[str, Any]:
         v = _num(value)
         if v is not None:
             tenants[tenant] = v
+    # sharded-sweep balance census (parallel/multihost.py
+    # publish_device_balance): per-device config counts fold into
+    # {device: {configs, pad_rows}} — what fleet.device_compute_skew
+    # aggregates across endpoints
+    sweep_devices: Dict[str, Dict[str, float]] = {}
+    for name, value in gauges.items():
+        if not name.startswith("sweep.device."):
+            continue
+        dev, _, field = name[len("sweep.device."):].partition(".")
+        v = _num(value)
+        if dev and field and v is not None:
+            sweep_devices.setdefault(dev, {})[field] = v
     return {
         "component": snap.get("component"),
         "uptime_s": _num(snap.get("uptime_s")),
@@ -177,6 +198,7 @@ def _endpoint_row(snap: Dict[str, Any]) -> Dict[str, Any]:
         or _num(compile_led.get("total_compiles")),
         "top_recompilers": _top_recompilers(compile_led),
         "devices": dev_rows,
+        "sweep_devices": sweep_devices,
         "alerts_total": _num(alerts.get("total")),
         "tenants": tenants,
     }
@@ -226,6 +248,34 @@ def _device_balance(
     return utilization, skew
 
 
+def _compute_balance(rows: Mapping[str, Dict[str, Any]]) -> Optional[float]:
+    """Worst PER-ENDPOINT (max-min)/max over per-device sharded-sweep
+    config counts — the compute-balance sibling of
+    :func:`_device_balance`'s memory skew.
+
+    Config counts are only comparable WITHIN one sweep: pooling absolute
+    counts across endpoints would read two perfectly balanced sweeps of
+    different sizes (a 1M run next to a 10k run) as severe imbalance. So
+    the skew is computed per endpoint (each endpoint's gauges describe
+    its own most recent sharded sweep) and the fleet gauge is the worst
+    of them. SPMD meshes step in lockstep, so row-count imbalance is
+    step-time imbalance; None when no endpoint has published sweep
+    balance gauges."""
+    worst: Optional[float] = None
+    for row in rows.values():
+        configs = [
+            c
+            for dv in (row.get("sweep_devices") or {}).values()
+            if (c := _num(dv.get("configs"))) is not None
+        ]
+        if not configs:
+            continue
+        hi = max(configs)
+        skew = 0.0 if hi <= 0 else (hi - min(configs)) / hi
+        worst = skew if worst is None else max(worst, skew)
+    return worst
+
+
 def derive_fleet(
     rows: Mapping[str, Dict[str, Any]],
     ok: int,
@@ -240,6 +290,7 @@ def derive_fleet(
     rate/trend fields are filled in by the collector, which owns the
     window."""
     utilization, skew = _device_balance(rows)
+    compute_skew = _compute_balance(rows)
 
     def _sum(field: str) -> Optional[float]:
         vals = [_num(r.get(field)) for r in rows.values()]
@@ -298,6 +349,9 @@ def derive_fleet(
             round(utilization, 4) if utilization is not None else None
         ),
         "device_mem_skew": round(skew, 4) if skew is not None else None,
+        "device_compute_skew": (
+            round(compute_skew, 4) if compute_skew is not None else None
+        ),
         "tenants": len(tenant_done) if tenant_done else None,
         "tenants_starved": starved,
         "tenant_throughput_ratio": ratio,
@@ -593,6 +647,7 @@ class FleetCollector:
             ("jobs_in_flight", "fleet.jobs_in_flight"),
             ("device_mem_utilization", "fleet.device_mem_utilization"),
             ("device_mem_skew", "fleet.device_mem_skew"),
+            ("device_compute_skew", "fleet.device_compute_skew"),
             ("worker_churn_per_min", "fleet.worker_churn_per_min"),
             ("queue_depth_trend_per_min", "fleet.queue_depth_trend_per_min"),
             ("compile_rate_per_min", "fleet.compile_rate_per_min"),
@@ -793,10 +848,11 @@ def format_fleet_table(
             _fmt(fleet.get("stale")), _fmt(fleet.get("workers_alive")),
             _fmt(fleet.get("queue_depth")), _fmt(fleet.get("jobs_in_flight")),
         ),
-        "       mem_util={}  mem_skew={}  churn/min={}  queue_trend/min={}  "
-        "compiles/min={}".format(
+        "       mem_util={}  mem_skew={}  compute_skew={}  churn/min={}  "
+        "queue_trend/min={}  compiles/min={}".format(
             _fmt(fleet.get("device_mem_utilization"), 3),
             _fmt(fleet.get("device_mem_skew"), 3),
+            _fmt(fleet.get("device_compute_skew"), 3),
             _fmt(fleet.get("worker_churn_per_min"), 2),
             _fmt(fleet.get("queue_depth_trend_per_min"), 2),
             _fmt(fleet.get("compile_rate_per_min"), 2),

@@ -76,6 +76,12 @@ _SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
 _LABEL_RULES: Tuple[Tuple[re.Pattern, str, str], ...] = (
     (re.compile(r"^runtime\.device\.(?P<label>\d+)\.(?P<field>[a-z_]+)$"),
      "runtime_device_{field}", "device"),
+    # sharded-sweep balance gauges (parallel/multihost.py
+    # publish_device_balance): sweep.device.<id>.configs ->
+    # sweep_device_configs{device="<id>"} — the per-device config-count /
+    # padding family fleet.device_compute_skew is derived from
+    (re.compile(r"^sweep\.device\.(?P<label>\d+)\.(?P<field>[a-z_]+)$"),
+     "sweep_device_{field}", "device"),
     (re.compile(r"^runtime\.compiles\.(?P<label>.+)$", re.DOTALL),
      "runtime_fn_compiles", "fn"),
     # roofline/cost families (obs/runtime.py _TrackedLowered cost

@@ -23,6 +23,7 @@ __all__ = [
     "BracketPlan",
     "hyperband_bracket",
     "hyperband_schedule",
+    "mesh_aligned_plan",
     "sh_promotion_mask",
     "sh_promotion_mask_compiled",
     "sh_promotion_mask_np",
@@ -99,6 +100,42 @@ def hyperband_schedule(
     """Plans for ``n_iterations`` consecutive HyperBand iterations."""
     return tuple(
         hyperband_bracket(i, min_budget, max_budget, eta) for i in range(n_iterations)
+    )
+
+
+def mesh_aligned_plan(
+    n_configs: int,
+    min_budget: float,
+    max_budget: float,
+    eta: float,
+    mesh_size: int = 1,
+) -> BracketPlan:
+    """One deep successive-halving bracket sized for a sharded mesh.
+
+    The 100k-1M tier's schedule: stage 0 starts at ``n_configs`` and each
+    rung keeps ``1/eta`` of the survivors, every stage count rounded UP to
+    a multiple of ``mesh_size`` (floor ``mesh_size``) so the config axis
+    shards evenly at every rung — the sharded sampler and the per-stage
+    sharding constraints both need divisible widths. Budgets are the full
+    ``min_budget..max_budget`` geometric ladder. The roundup waste per
+    stage is at most ``mesh_size - 1`` rows — negligible against 100k+
+    rows, and zero when ``n_configs`` and ``eta`` are powers of two on a
+    pow2 mesh (the amortization the pow2 bucket geometry already relies
+    on).
+    """
+    m = max(int(mesh_size), 1)
+    ladder = budget_ladder(min_budget, max_budget, eta)
+    depth = len(ladder)
+    ns = []
+    for j in range(depth):
+        n = max(int(n_configs * float(eta) ** (-j)), 1)
+        ns.append(max(((n + m - 1) // m) * m, m))
+    # roundup of a decreasing profile can create equal neighbors but must
+    # never create an INCREASING step
+    for j in range(depth - 2, -1, -1):
+        ns[j] = max(ns[j], ns[j + 1])
+    return BracketPlan(
+        num_configs=tuple(ns), budgets=tuple(float(b) for b in ladder)
     )
 
 

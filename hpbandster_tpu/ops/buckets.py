@@ -191,13 +191,26 @@ def build_bucket_set(
 
 
 def _mesh_pad(bucket: BucketPlan, mesh_size: int) -> BucketPlan:
-    """Stage-0 width padded to a mesh multiple (only the input is sharded,
-    matching ``make_fused_bracket_fn``'s policy)."""
+    """EVERY stage width padded to a mesh multiple, so each rung of the
+    ladder stays evenly shardable over the config axis (the per-stage
+    :func:`~hpbandster_tpu.ops.fused.shard_rows` constraints only apply to
+    divisible widths).
+
+    The waste is amortized by the pow2 bucket geometry: widths are already
+    powers of two (floor 8), so on a pow2 mesh any width >= mesh_size is a
+    multiple for free and only tail rungs narrower than the mesh pad up to
+    one row per shard. Per-stage relative waste is bounded by
+    ``(ceil(w/m)*m - w)/w <= (m-1)/w`` — exactly zero on pow2 meshes with
+    ``w >= m`` (docs/perf_notes.md "Mesh sharding")."""
     m = max(int(mesh_size), 1)
-    if m == 1 or bucket.widths[0] % m == 0:
+    if m == 1 or all(w % m == 0 for w in bucket.widths):
         return bucket
-    w0 = ((bucket.widths[0] + m - 1) // m) * m
-    return BucketPlan(widths=(w0,) + bucket.widths[1:], budgets=bucket.budgets)
+    widths = [((w + m - 1) // m) * m for w in bucket.widths]
+    # mesh roundup of a non-increasing profile stays non-increasing, but
+    # guard the invariant like build_bucket_set does
+    for j in range(len(widths) - 2, -1, -1):
+        widths[j] = max(widths[j], widths[j + 1])
+    return BucketPlan(widths=tuple(widths), budgets=bucket.budgets)
 
 
 def fused_sh_bracket_bucketed(
@@ -205,6 +218,8 @@ def fused_sh_bracket_bucketed(
     vectors,
     counts,
     bucket: BucketPlan,
+    mesh=None,
+    axis: str = "config",
 ):
     """One bucketed bracket, traceable under ``jit``.
 
@@ -220,9 +235,16 @@ def fused_sh_bracket_bucketed(
     index-keyed argsort packs them first, a static slice narrows to the
     next stage's width. While a stage's count is 0 (pre-entry) the carry
     is the identity head slice, so entering rows survive untouched.
+
+    ``mesh``/``axis`` keep each stage's rows sharded over the config axis
+    (``ops.fused.shard_rows``) — the rank mask then reduces across shards
+    on-device (ICI collectives) and no stage is ever gathered to one
+    device. Values are bit-identical with or without the mesh.
     """
     import jax
     import jax.numpy as jnp
+
+    from hpbandster_tpu.ops.fused import shard_rows
 
     widths = bucket.widths
     budgets = bucket.budgets
@@ -232,7 +254,7 @@ def fused_sh_bracket_bucketed(
     def eval_stage(vecs, budget: float):
         return jax.vmap(lambda v: eval_fn(v, budget))(vecs).astype(jnp.float32)
 
-    cur_vecs = vectors
+    cur_vecs = shard_rows(vectors, mesh, axis)
     cur_idx = jnp.arange(widths[0], dtype=jnp.int32)
     out = []
     for t in range(depth):
@@ -255,7 +277,7 @@ def fused_sh_bracket_bucketed(
         sel_ranked = order[:w_next]
         sel_identity = jnp.arange(w_next, dtype=jnp.int32)
         sel = jnp.where(counts[t] > 0, sel_ranked, sel_identity)
-        cur_vecs = cur_vecs[sel]
+        cur_vecs = shard_rows(cur_vecs[sel], mesh, axis)
         cur_idx = cur_idx[sel]
     return out
 
@@ -338,7 +360,9 @@ class _BucketRunner:
         self._dim: Optional[int] = None
 
         def bracket(vectors, counts):
-            stages = fused_sh_bracket_bucketed(eval_fn, vectors, counts, bucket)
+            stages = fused_sh_bracket_bucketed(
+                eval_fn, vectors, counts, bucket, mesh=mesh, axis=axis
+            )
             import jax.numpy as jnp
 
             return (

@@ -24,7 +24,7 @@ import numpy as np
 
 from hpbandster_tpu.obs.runtime import note_transfer, tracked_jit
 
-__all__ = ["fused_sh_bracket", "make_fused_bracket_fn"]
+__all__ = ["fused_sh_bracket", "make_fused_bracket_fn", "shard_rows"]
 
 #: crashed (NaN) losses map here for ranking: behind any real loss, ahead of
 #: the +inf padding rows, ties broken index-stably by top_k — the same
@@ -34,12 +34,47 @@ __all__ = ["fused_sh_bracket", "make_fused_bracket_fn"]
 _CRASH_RANK = np.float32(3.0e38)
 
 
+def shard_rows(x: jax.Array, mesh, axis: str = "config") -> jax.Array:
+    """Constrain a leading batch dim to stay sharded over ``axis``.
+
+    Identity on values (a sharding constraint never changes bits) and a
+    no-op without a mesh or when the row count does not divide evenly —
+    XLA is then free to choose its own layout for that (small) stage.
+    Inserted between the stages of a sharded fused bracket so the config
+    axis stays distributed for the whole rung ladder: survivor gathers and
+    the rank reduction become ICI collectives instead of XLA deciding to
+    home the batch on one device.
+    """
+    if mesh is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from hpbandster_tpu.parallel.mesh import is_multiprocess_mesh, shard_count
+
+    m = shard_count(mesh, axis)
+    if m <= 1 or x.shape[0] % m != 0:
+        return x
+    if is_multiprocess_mesh(mesh) and jax.default_backend() == "cpu":
+        # CPU PJRT does not implement multiprocess computations at all
+        # ("Multiprocess computations aren't implemented on the CPU
+        # backend"), so forcing cross-process layouts here can only add
+        # failure modes — the DCN-on-CPU test pods keep XLA's own layout
+        # choice, the pre-constraint behavior. Real pods (TPU/GPU) keep
+        # the constraints: that is where the ICI/DCN reduction lives.
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(axis))
+    )
+
+
 def fused_sh_bracket(
     eval_fn: Callable[[jax.Array, float], jax.Array],
     vectors: jax.Array,
     num_configs: Sequence[int],
     budgets: Sequence[float],
     rank_fn: Callable[[jax.Array, jax.Array, float], jax.Array] = None,
+    mesh=None,
+    axis: str = "config",
 ) -> List[Tuple[jax.Array, jax.Array]]:
     """Trace one whole bracket. Returns per-stage ``(indices, losses)``
     where ``indices`` index the original (unpadded) stage-0 rows.
@@ -53,6 +88,12 @@ def fused_sh_bracket(
     (lower = better; NaN = never promote). Default: the current stage's raw
     losses — plain successive halving. ``FusedH2BO`` passes the power-law
     learning-curve extrapolation here.
+
+    ``mesh``/``axis`` pin each stage's survivor batch to stay sharded over
+    the config axis (:func:`shard_rows`) — bit-identical results (a
+    constraint never changes values; a 1-device mesh is the unsharded
+    program), but the rung reduction and survivor gather lower to ICI
+    collectives instead of a single-device round-trip.
     """
     n0 = int(num_configs[0])
     n_rows = vectors.shape[0]
@@ -85,6 +126,7 @@ def fused_sh_bracket(
             scores = jnp.where(jnp.isnan(hist[:, -1]), jnp.nan, scores)
         return scores
 
+    vectors = shard_rows(vectors, mesh, axis)
     losses0 = eval_stage(vectors, float(budgets[0]))
     cur_idx = jnp.arange(n_rows, dtype=jnp.int32)
     history = [losses0]  # per-stage losses of the CURRENT survivor set
@@ -96,7 +138,7 @@ def fused_sh_bracket(
         _, top = jax.lax.top_k(-cur_key, k)
         top = jnp.sort(top)  # preserve original ordering among survivors
         sel_idx = cur_idx[top]
-        sel_vecs = vectors[sel_idx]
+        sel_vecs = shard_rows(vectors[sel_idx], mesh, axis)
         losses_s = eval_stage(sel_vecs, float(budgets[s]))
         cur_idx = sel_idx
         history = [col[top] for col in history] + [losses_s]
@@ -164,7 +206,9 @@ def make_fused_bracket_fn(
 
     def bracket(vectors: jax.Array):
         return _pack_stages(
-            fused_sh_bracket(eval_fn, vectors, num_configs, budgets)
+            fused_sh_bracket(
+                eval_fn, vectors, num_configs, budgets, mesh=mesh, axis=axis
+            )
         )
 
     # donation contract (docs/perf_notes.md): the packed (idx, loss)
@@ -204,6 +248,16 @@ def make_fused_bracket_fn(
                     [vectors, np.zeros((n_pad - n0, vectors.shape[1]), np.float32)]
                 )
             note_transfer("h2d", vectors.nbytes)
+            from hpbandster_tpu.parallel.mesh import is_multiprocess_mesh
+
+            if is_multiprocess_mesh(mesh):
+                # multiprocess meshes reject raw numpy against a sharded
+                # in_sharding — build the global array explicitly (every
+                # rank holds identical rows), like _BucketRunner.dispatch
+                host = vectors
+                vectors = jax.make_array_from_callback(
+                    host.shape, shard, lambda idx: host[idx]
+                )
             return jitted(vectors)
 
     def runner(vectors):

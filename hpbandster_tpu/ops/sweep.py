@@ -40,7 +40,12 @@ import numpy as np
 
 from hpbandster_tpu.obs.runtime import tracked_jit
 from hpbandster_tpu.ops.bracket import BracketPlan
-from hpbandster_tpu.ops.fused import fused_sh_bracket, _pack_stages
+from hpbandster_tpu.ops.fused import (
+    _CRASH_RANK,
+    _pack_stages,
+    fused_sh_bracket,
+    shard_rows,
+)
 from hpbandster_tpu.ops.kde import (
     KDE,
     fit_kde_pair_masked,
@@ -50,8 +55,9 @@ from hpbandster_tpu.ops.kde import (
 )
 
 __all__ = ["SpaceCodec", "build_space_codec", "quantize_unit", "random_unit",
-           "compile_active_mask", "compile_forbidden_mask",
-           "make_fused_sweep_fn", "SweepBracketOutput", "plan_additions"]
+           "random_unit_sharded", "compile_active_mask",
+           "compile_forbidden_mask", "make_fused_sweep_fn",
+           "SweepBracketOutput", "SweepIncumbent", "plan_additions"]
 
 
 def plan_additions(plans: Sequence[BracketPlan]) -> dict:
@@ -241,6 +247,40 @@ def random_unit(codec: SpaceCodec, key: jax.Array, n: int) -> jax.Array:
     out = jnp.where(kind == 2, idx, u)
     out = jnp.where(kind == 3, 0.0, out)
     return out
+
+
+def random_unit_sharded(
+    codec: SpaceCodec, key: jax.Array, n: int, n_shards: int
+) -> jax.Array:
+    """Per-shard PRNG derivation of :func:`random_unit` for a config batch
+    sharded ``n_shards`` ways.
+
+    Shard ``s`` draws its ``n // n_shards`` rows from
+    ``jax.random.fold_in(key, s)`` — each shard's stream is independent of
+    the others and of the batch's total size, so under a sharded jit every
+    device generates exactly its own rows locally (no sampled bytes cross
+    the ICI before evaluation). With ``n_shards == 1`` the base key is used
+    UNFOLDED, so the sharded sampler on a 1-device mesh is bit-identical
+    to :func:`random_unit` (the parity bar in ``tests/test_sharded.py``).
+    Different shard counts are distinct — equally valid — RNG consumers,
+    the same contract as the dynamic-count tier (docs/perf_notes.md).
+    """
+    n_shards = max(int(n_shards), 1)
+    if n_shards == 1:
+        return random_unit(codec, key, n)
+    if n % n_shards != 0:
+        raise ValueError(
+            f"sharded sampling needs n % n_shards == 0, got {n} rows over "
+            f"{n_shards} shards — pad the stage-0 count to a mesh multiple "
+            "(parallel.mesh.pad_to_shards / ops.bracket.mesh_aligned_plan)"
+        )
+    per = n // n_shards
+    return jnp.concatenate(
+        [
+            random_unit(codec, jax.random.fold_in(key, s), per)
+            for s in range(n_shards)
+        ]
+    )
 
 
 def _decode_values(codec: SpaceCodec, q: jax.Array) -> jax.Array:
@@ -539,6 +579,27 @@ class SweepBracketOutput(NamedTuple):
     loss_packed: jax.Array
 
 
+class SweepIncumbent(NamedTuple):
+    """The ``incumbent_only=True`` sweep's entire device->host payload.
+
+    At 100k-1M configs the per-stage records are the transfer bill (and
+    the host bookkeeping bill); the 100k/1M tiers only need the winner.
+    The incumbent is the best FINAL-stage (largest-budget) loss across
+    every bracket — crashed (NaN) rows rank behind any real loss via the
+    shared crash-rank constant, so an all-crashed sweep still returns a
+    row (with a NaN loss) rather than garbage.
+    """
+
+    #: the winning configuration's quantized vector, f32[d]
+    vector: jax.Array
+    #: its final-stage loss (NaN = every candidate crashed), f32[]
+    loss: jax.Array
+    #: which bracket (index into ``plans``) produced it, i32[]
+    bracket: jax.Array
+    #: each bracket's best final-stage loss, f32[len(plans)]
+    per_bracket_loss: jax.Array
+
+
 #: device imputation moved to ops/kde.py (the in-trace refit op needs it
 #: too); the old private name stays importable for existing callers
 _impute_conditional_device = impute_conditional_masked
@@ -604,6 +665,8 @@ def make_fused_sweep_fn(
     dynamic_counts: bool = False,
     capacities: Optional[dict] = None,
     return_state: bool = False,
+    shard_sampling: bool = False,
+    incumbent_only: bool = False,
 ) -> Callable[..., List[SweepBracketOutput]]:
     """Trace + jit the whole sweep; returns ``fn(seed[, warm_v, warm_l])``.
 
@@ -640,6 +703,27 @@ def make_fused_sweep_fn(
     (budget -> slots, must cover warm + every plan's additions) pins the
     buffer shapes so all chunks of one run agree on them.
 
+    ``shard_sampling=True`` (requires ``mesh``) is the 100k-1M scale mode:
+    stage-0 proposals are drawn per shard of the config axis
+    (:func:`random_unit_sharded` — shard ``s`` folds its index into the
+    bracket key, so every device generates its own rows locally and no
+    candidate bytes ever cross the host link or the ICI before
+    evaluation), and every bracket stage plus the dynamic observation
+    buffers carry explicit sharding constraints over ``axis`` so the
+    config batch stays distributed through the whole rung ladder — rung
+    promotion masks lower to on-device reductions across shards, never a
+    host gather. On a 1-device mesh this mode is BIT-IDENTICAL to the
+    unsharded program (the parity bar in ``tests/test_sharded.py``);
+    across mesh sizes it is a distinct RNG consumer (per-shard streams),
+    like the dynamic tier.
+
+    ``incumbent_only=True`` shrinks the device->host payload to a single
+    :class:`SweepIncumbent` — the winning (vector, loss, bracket) plus
+    per-bracket best losses — instead of per-stage records: at 1M configs
+    the stage records ARE the transfer (and host-replay) bill, and only
+    the final incumbent needs to leave the device loop. With
+    ``return_state`` the fn returns ``(incumbent, state)``.
+
     ``return_state=True`` (dynamic tier only) makes the jitted fn ALSO
     return the end-of-sweep observation state ``(obs_v, obs_l, counts)``
     — the same pytrees the warm inputs arrived as — so a chunked driver
@@ -654,6 +738,8 @@ def make_fused_sweep_fn(
     donation is active the inputs are CONSUMED per call; pass fresh
     arrays (or the previous call's returned state) each time.
     """
+    from hpbandster_tpu.parallel.mesh import is_multiprocess_mesh, shard_count
+
     d = int(codec.kind.shape[0])
     if forbidden_fn is not None and fallback_vector is None:
         raise ValueError("forbidden_fn requires a fallback_vector")
@@ -662,6 +748,28 @@ def make_fused_sweep_fn(
             "return_state=True requires dynamic_counts=True: the static "
             "tier burns counts into the trace, there is no reusable state"
         )
+    if shard_sampling and mesh is None:
+        raise ValueError("shard_sampling=True requires a mesh")
+    if incumbent_only and not plans:
+        raise ValueError("incumbent_only=True needs at least one bracket")
+    n_shards = shard_count(mesh, axis) if shard_sampling else 1
+    if n_shards > 1:
+        for p in plans:
+            if int(p.num_configs[0]) % n_shards:
+                raise ValueError(
+                    f"shard_sampling: stage-0 count {p.num_configs[0]} is "
+                    f"not a multiple of the {n_shards}-way '{axis}' axis — "
+                    "build plans with ops.bracket.mesh_aligned_plan (or pad "
+                    "via parallel.mesh.pad_to_shards)"
+                )
+    #: pin the dynamic observation state's boundary shardings over the
+    #: config axis on single-process meshes: chunked drivers thread the
+    #: returned state straight back into the (AOT-compiled) next call, so
+    #: input and output shardings must be stable by CONSTRUCTION, not by
+    #: XLA's whim. Multi-process meshes keep the replicated contract below.
+    pin_state_shards = (
+        dynamic_counts and mesh is not None and not is_multiprocess_mesh(mesh)
+    )
     min_pts = (d + 1) if min_points_in_model is None else max(int(min_points_in_model), d + 1)
     plans = [BracketPlan(tuple(p.num_configs), tuple(p.budgets)) for p in plans]
     warm_counts = {float(b): int(n) for b, n in (warm_counts or {}).items() if n > 0}
@@ -805,6 +913,11 @@ def make_fused_sweep_fn(
                     live & ~jnp.isnan(l), l, jnp.inf
                 )
                 counts[b] = n_b
+            if pin_state_shards:
+                obs_v = {b: shard_rows(v, mesh, axis)
+                         for b, v in obs_v.items()}
+                obs_l = {b: shard_rows(l, mesh, axis)
+                         for b, l in obs_l.items()}
         else:
             obs_v = {
                 b: jnp.zeros((cap, d), jnp.float32) for b, cap in caps.items()
@@ -820,13 +933,25 @@ def make_fused_sweep_fn(
                 )
                 counts[b] = n
         outputs: List[SweepBracketOutput] = []
+        if incumbent_only:
+            best_key = jnp.asarray(jnp.inf, jnp.float32)
+            best_loss = jnp.asarray(jnp.nan, jnp.float32)
+            best_vec = jnp.zeros((d,), jnp.float32)
+            best_bracket = jnp.asarray(-1, jnp.int32)
+            per_bracket: List[jax.Array] = []
 
         for b_i, plan in enumerate(plans):
             n0 = plan.num_configs[0]
             k_rand, k_prop, k_frac, k_fit = jax.random.split(
                 jax.random.fold_in(key, b_i), 4
             )
-            rand_vecs = random_unit(codec, k_rand, n0)
+            # per-shard derivation under shard_sampling: each shard's rows
+            # come from its own folded key, so generation stays local to
+            # the owning device (n_shards == 1 falls through to the
+            # unfolded base key — the 1-device-mesh bit-parity contract)
+            rand_vecs = random_unit_sharded(codec, k_rand, n0, n_shards)
+            if n_shards > 1:
+                rand_vecs = shard_rows(rand_vecs, mesh, axis)
 
             if dynamic_counts:
                 if not any_trainable:
@@ -925,6 +1050,10 @@ def make_fused_sweep_fn(
             stages = fused_sh_bracket(
                 eval_fn, eval_vectors, plan.num_configs, plan.budgets,
                 rank_fn=rank_fn,
+                # per-stage sharding constraints: the rung ladder's
+                # survivor batches stay distributed over the config axis
+                # (promotion masks reduce across shards on-device)
+                mesh=mesh if shard_sampling else None, axis=axis,
             )
 
             for (idx_s, losses_s), k_s, budget in zip(
@@ -945,18 +1074,49 @@ def make_fused_sweep_fn(
                     obs_l[b] = obs_l[b].at[c:c + k_s].set(upd_l)
                 counts[b] = c + k_s
 
-            idx_packed, loss_packed = _pack_stages(stages)
-            outputs.append(
-                SweepBracketOutput(
-                    out_vectors[:n0], mb_mask, idx_packed, loss_packed
+            if incumbent_only:
+                # only the winner leaves the device loop: reduce the final
+                # (largest-budget) stage to its best row and fold it into
+                # the running cross-bracket incumbent — crashed (NaN) rows
+                # rank behind every real loss via the shared crash rank
+                idx_f, loss_f = stages[-1]
+                key_f = jnp.where(jnp.isnan(loss_f), _CRASH_RANK, loss_f)
+                a = jnp.argmin(key_f)
+                cand_key = key_f[a]
+                take = cand_key < best_key
+                best_key = jnp.where(take, cand_key, best_key)
+                best_loss = jnp.where(take, loss_f[a], best_loss)
+                best_vec = jnp.where(take, out_vectors[idx_f[a]], best_vec)
+                best_bracket = jnp.where(
+                    take, jnp.asarray(b_i, jnp.int32), best_bracket
                 )
+                per_bracket.append(loss_f[a])
+            else:
+                idx_packed, loss_packed = _pack_stages(stages)
+                outputs.append(
+                    SweepBracketOutput(
+                        out_vectors[:n0], mb_mask, idx_packed, loss_packed
+                    )
+                )
+        result = (
+            SweepIncumbent(
+                best_vec, best_loss, best_bracket, jnp.stack(per_bracket)
             )
+            if incumbent_only else outputs
+        )
         if return_state:
             # the donated warm inputs alias these outputs buffer-for-buffer
             # (same pytree structure, shapes, dtypes) — the in-place state
-            # thread chunked drivers hand back to the next call
-            return outputs, (obs_v, obs_l, counts)
-        return outputs
+            # thread chunked drivers hand back to the next call. Boundary
+            # shardings re-pinned so the threaded state re-enters the AOT
+            # executable with exactly the sharding it was lowered for.
+            if pin_state_shards:
+                obs_v = {b: shard_rows(v, mesh, axis)
+                         for b, v in obs_v.items()}
+                obs_l = {b: shard_rows(l, mesh, axis)
+                         for b, l in obs_l.items()}
+            return result, (obs_v, obs_l, counts)
+        return result
 
     from hpbandster_tpu.parallel.mesh import is_multiprocess_mesh
 

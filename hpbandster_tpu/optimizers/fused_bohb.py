@@ -481,6 +481,10 @@ class FusedBOHB:
             chunk_plans, plans = plans[:chunk], plans[chunk:]
             seed = np.uint32(self.rng.integers(2**32, dtype=np.uint32))
             overlap_s = None
+            #: host bytes materialized by the per-shard streamed warm
+            #: upload (jax Arrays, so the generic non-jax-leaf sum below
+            #: cannot see them)
+            streamed_bytes = 0
             try:
                 run_caps = None
                 if dynamic:
@@ -511,6 +515,16 @@ class FusedBOHB:
                         # device state straight back — zero warm-state
                         # bytes cross the host link
                         args = (seed,) + dev_state
+                    elif self._can_stream_warm(multiprocess, run_caps):
+                        # sharded mesh: warm buffers stream up PER SHARD
+                        # SLICE — the full-capacity array (1M+ rows at the
+                        # fused_1M scale) never materializes on host in
+                        # one piece (ISSUE 10: bounded peak host RSS,
+                        # probed by the bench tier)
+                        args, streamed_bytes = self._stream_warm_args(
+                            seed, run_caps, d
+                        )
+                        dev_state = None  # stale shapes: never reuse
                     else:
                         warm_v_pad, warm_l_pad, warm_n = {}, {}, {}
                         for b, cap in run_caps.items():
@@ -537,7 +551,7 @@ class FusedBOHB:
                 # Arrays (measuring after would read 0 on the DCN tier).
                 # Device-resident state leaves cost nothing: that is the
                 # state-threading win.
-                upload_bytes = sum(
+                upload_bytes = streamed_bytes + sum(
                     int(getattr(l, "nbytes", 0))
                     for l in jax.tree_util.tree_leaves(args)
                     if not isinstance(l, jax.Array)
@@ -687,6 +701,91 @@ class FusedBOHB:
         return Result(
             list(self.iterations) + self.warmstart_iteration, self.config
         )
+
+    def _can_stream_warm(self, multiprocess: bool, run_caps) -> bool:
+        """Streamed per-shard warm uploads apply on single-process meshes
+        whose capacities shard evenly — exactly the cases where the sweep
+        pins the state's boundary shardings over the config axis
+        (``ops/sweep.py`` ``pin_state_shards`` + ``shard_rows``'s
+        divisible-widths policy), so streamed inputs and threaded device
+        state always agree on sharding. Anything else keeps the plain
+        host-buffer path."""
+        if self.mesh is None or multiprocess:
+            return False
+        from hpbandster_tpu.parallel.mesh import shard_count
+
+        n_shards = shard_count(self.mesh, self.axis)
+        return n_shards > 1 and all(
+            cap % n_shards == 0 for cap in run_caps.values()
+        )
+
+    def _stream_warm_args(self, seed, run_caps, d):
+        """Warm observation buffers for a single-process MESH run, built
+        per shard slice through ``jax.make_array_from_callback``.
+
+        The plain path allocates each budget's full-capacity buffer on
+        host before upload — at the 1M-config scale that is the one place
+        the chunked driver materializes O(total configs) host memory in a
+        single piece. Here the callback only ever holds ONE shard's slice
+        (capacity / shard count rows), so peak host RSS is bounded by a
+        slice regardless of sweep size (the bench ``fused_100k`` /
+        ``fused_1M`` RSS probe). Shardings match the sweep's in-trace
+        state pins (``ops/sweep.py`` ``pin_state_shards``): the AOT
+        executable sees identical input shardings whether the state
+        arrives streamed (chunk 0 / after a capacity doubling) or as the
+        previous chunk's threaded device state. Returns
+        ``(args, host_bytes_materialized)``.
+        """
+        import jax
+
+        from hpbandster_tpu.parallel.mesh import batch_sharding, shard_count
+
+        n_shards = shard_count(self.mesh, self.axis)
+        shard = batch_sharding(self.mesh, self.axis)
+        warm_v, warm_l, warm_n = {}, {}, {}
+        bytes_up = 0
+        for b, cap in run_caps.items():
+            if cap % n_shards:
+                # _can_stream_warm guarantees divisible caps; a
+                # differently-sharded streamed input would violate the
+                # AOT sharding-stability contract above — fail loudly
+                # rather than silently falling back to replication
+                raise ValueError(
+                    f"streamed warm upload needs capacities divisible by "
+                    f"the {n_shards}-way '{self.axis}' axis, got {cap} for "
+                    f"budget {b} (gate with _can_stream_warm)"
+                )
+            src_v = self._warm_v.get(b)
+            src_l = self._warm_l.get(b)
+            n = 0 if src_v is None else len(src_v)
+
+            def fill(idx, shape, fill_value, src, n=n):
+                start, stop, _ = idx[0].indices(shape[0])
+                buf = np.full((stop - start,) + shape[1:], fill_value,
+                              np.float32)
+                if src is not None and start < n:
+                    take = src[start:min(stop, n)]
+                    buf[: len(take)] = take
+                return buf
+
+            # bind per-iteration values as defaults: the callbacks run
+            # inside make_array_from_callback but must not see a later
+            # iteration's closure state
+            warm_v[b] = jax.make_array_from_callback(
+                (cap, d), shard,
+                lambda idx, cap=cap, src=src_v, fill=fill: fill(
+                    idx, (cap, d), 0.0, src
+                ),
+            )
+            warm_l[b] = jax.make_array_from_callback(
+                (cap,), shard,
+                lambda idx, cap=cap, src=src_l, fill=fill: fill(
+                    idx, (cap,), np.inf, src
+                ),
+            )
+            warm_n[b] = np.int32(n)
+            bytes_up += cap * d * 4 + cap * 4 + 4
+        return (seed, warm_v, warm_l, warm_n), bytes_up
 
     def _write_timings_sidecar(self) -> None:
         """Persist ``run_stats`` as ``fused_timings.json`` next to the

@@ -11,6 +11,8 @@ from hpbandster_tpu.parallel.mesh import (  # noqa: F401
     config_mesh,
     config_model_mesh,
     is_multiprocess_mesh,
+    pad_to_shards,
+    shard_count,
 )
 from hpbandster_tpu.parallel.backends import VmapBackend  # noqa: F401
 from hpbandster_tpu.parallel.batched_executor import BatchedExecutor  # noqa: F401

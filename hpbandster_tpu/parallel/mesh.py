@@ -20,6 +20,8 @@ __all__ = [
     "config_model_mesh",
     "batch_sharding",
     "is_multiprocess_mesh",
+    "shard_count",
+    "pad_to_shards",
 ]
 
 
@@ -68,3 +70,23 @@ def config_model_mesh(
 def batch_sharding(mesh: Mesh, axis: str = "config") -> NamedSharding:
     """Sharding that splits a leading batch dim over ``axis``, replicating rest."""
     return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def shard_count(mesh: Optional[Mesh], axis: str = "config") -> int:
+    """Number of shards along ``axis`` (1 for no mesh / absent axis).
+
+    The ONE definition of "how many ways is the config batch split" — the
+    sharded samplers (``ops.sweep.random_unit_sharded``), the per-stage
+    sharding constraints in the fused kernels, and the per-device balance
+    gauges all derive their geometry from this, and they must agree or a
+    shard's PRNG stream and its device placement drift apart.
+    """
+    if mesh is None:
+        return 1
+    return int(dict(mesh.shape).get(axis, 1))
+
+
+def pad_to_shards(n: int, mesh: Optional[Mesh], axis: str = "config") -> int:
+    """``n`` rounded up to a multiple of the ``axis`` shard count."""
+    m = shard_count(mesh, axis)
+    return ((int(n) + m - 1) // m) * m

@@ -22,13 +22,27 @@ membership per run (SURVEY.md §7 "Multi-host elasticity").
 from __future__ import annotations
 
 import logging
-from typing import Optional
+import time
+from typing import Any, Dict, List, Optional
 
 from hpbandster_tpu.parallel.batched_executor import BatchedExecutor
 
 logger = logging.getLogger("hpbandster_tpu.multihost")
 
-__all__ = ["initialize_multihost", "MultiHostBatchedExecutor", "is_primary_host"]
+#: process-wide sharded-sweep fn cache — one traced program per
+#: (objective, chunk schedule, space, mesh, knobs), same policy as
+#: ops.fused._FUSED_FN_CACHE / FusedBOHB._SWEEP_EXE_CACHE
+from hpbandster_tpu.utils.lru import LRUCache as _LRUCache
+
+_SHARDED_FN_CACHE: _LRUCache = _LRUCache(maxsize=16)
+
+__all__ = [
+    "initialize_multihost",
+    "MultiHostBatchedExecutor",
+    "is_primary_host",
+    "run_sharded_fused_sweep",
+    "publish_device_balance",
+]
 
 
 def initialize_multihost(
@@ -75,3 +89,329 @@ class MultiHostBatchedExecutor(BatchedExecutor):
         #: use this to gate side effects (result_logger, checkpoints):
         #: pass them to the Master only when primary is True
         self.primary = jax.process_index() == 0
+
+    def run_sharded_sweep(self, n_configs: int, **kwargs) -> Dict[str, Any]:
+        """Run one mesh-sharded fused sweep over the WHOLE pod.
+
+        Every host calls this with identical arguments (the SPMD driver
+        contract above); the sweep is a single global computation over a
+        pod-wide 'config' mesh — losses reduce over ICI within a slice and
+        DCN between slices, and only the final incumbent (a ``d``-vector +
+        scalar loss, replicated to every rank) leaves the device loop.
+        Per-device balance gauges are published for this process's local
+        devices only; a fleet collector aggregates the rest.
+        """
+        eval_fn = kwargs.pop("eval_fn", None) or self.backend.eval_fn
+        return run_sharded_fused_sweep(
+            eval_fn, self.configspace, n_configs=n_configs, **kwargs
+        )
+
+
+def publish_device_balance(
+    mesh,
+    axis: str,
+    per_shard_configs: List[int],
+    per_shard_pad: List[int],
+) -> Optional[float]:
+    """Publish per-device config counts + compute-balance gauges.
+
+    ``per_shard_configs[s]`` is the number of TRUE config rows shard ``s``
+    evaluated this sweep; ``per_shard_pad[s]`` its padding rows (evaluated
+    but never reported). Gauges land as ``sweep.device.<id>.configs`` /
+    ``.pad_rows`` for this process's LOCAL devices (each pod rank owns its
+    own), the Prometheus renderer re-expresses them as the
+    ``sweep_device_*{device=}`` label family, and the fleet collector
+    derives ``fleet.device_compute_skew`` — the compute-balance sibling of
+    ``fleet.device_mem_skew``. On an SPMD mesh all devices step in
+    lockstep, so the per-device row count IS the step-time balance: a
+    nonzero skew means some device spends its steps on padding or an
+    uneven shard. Returns the mesh-wide shard skew ((max-min)/max over
+    ``per_shard_configs`` — identical on every rank, which is why every
+    rank may publish the same ``sweep.balance_skew`` gauge; None if
+    unmeasurable).
+    """
+    import jax
+
+    from hpbandster_tpu.obs.metrics import get_metrics
+    from hpbandster_tpu.parallel.mesh import shard_count
+
+    n_shards = shard_count(mesh, axis)
+    if len(per_shard_configs) != n_shards:
+        raise ValueError(
+            f"{len(per_shard_configs)} shard counts for a {n_shards}-shard "
+            f"'{axis}' axis"
+        )
+    reg = get_metrics()
+    # devices along the sharded axis, in axis order: shard s's rows live on
+    # mesh.devices[... s ...] (a 1-D config mesh is the common case; on a
+    # 2-D mesh each shard's rows replicate over the other axes, so every
+    # device in the slice reports the shard's count)
+    try:
+        axis_index = list(mesh.axis_names).index(axis)
+    except ValueError:
+        return None
+    import numpy as np
+
+    devices = np.moveaxis(np.asarray(mesh.devices), axis_index, 0)
+    devices = devices.reshape(n_shards, -1)
+    proc = jax.process_index()
+    for s in range(n_shards):
+        for dev in devices[s]:
+            if dev.process_index != proc:
+                continue
+            reg.gauge(f"sweep.device.{dev.id}.configs").set(
+                float(per_shard_configs[s])
+            )
+            reg.gauge(f"sweep.device.{dev.id}.pad_rows").set(
+                float(per_shard_pad[s])
+            )
+    hi = max(per_shard_configs) if per_shard_configs else 0
+    skew = None if hi <= 0 else (hi - min(per_shard_configs)) / hi
+    if skew is not None:
+        reg.gauge("sweep.balance_skew").set(round(float(skew), 6))
+    return skew
+
+
+def run_sharded_fused_sweep(
+    eval_fn,
+    configspace,
+    *,
+    n_configs: int,
+    n_brackets: int = 1,
+    min_budget: float = 1.0,
+    max_budget: float = 9.0,
+    eta: float = 3.0,
+    seed: int = 0,
+    mesh=None,
+    axis: str = "config",
+    model: bool = False,
+    num_samples: int = 64,
+    chunk_brackets: Optional[int] = None,
+    publish_gauges: bool = True,
+) -> Dict[str, Any]:
+    """Mesh-sharded fused successive halving at 100k-1M config scale.
+
+    One deep bracket of ``n_configs`` (stage counts mesh-aligned,
+    :func:`~hpbandster_tpu.ops.bracket.mesh_aligned_plan`) repeated
+    ``n_brackets`` times, compiled as ONE sharded device program per chunk
+    shape: per-shard on-device sampling (no candidate bytes cross the host
+    link), per-stage sharding constraints over ``axis`` (rung promotions
+    reduce across shards over ICI/DCN), and an ``incumbent_only`` payload —
+    the winning vector + loss is the only thing fetched. ``model=True``
+    turns the BOHB KDE on (observation buffers then shard over the config
+    axis and, with ``chunk_brackets``, thread device-to-device between
+    chunks under the PR-6 donation contract); the default is
+    HyperBand-style random proposals, the honest mode at 1M configs where
+    a KDE fit over the full observation set would dominate.
+
+    Returns a stats dict (incumbent, per-device balance, chunk timings).
+    SPMD multi-host: call on every rank with identical arguments over a
+    pod-spanning mesh; the returned incumbent is identical on all ranks.
+    """
+    import jax
+    import numpy as np
+
+    from hpbandster_tpu.obs.runtime import note_transfer
+    from hpbandster_tpu.ops.bracket import mesh_aligned_plan
+    from hpbandster_tpu.ops.sweep import (
+        build_space_codec,
+        make_fused_sweep_fn,
+        plan_additions,
+    )
+    from hpbandster_tpu.parallel.mesh import (
+        batch_sharding,
+        config_mesh,
+        shard_count,
+    )
+
+    if mesh is None:
+        mesh = config_mesh()
+    n_shards = shard_count(mesh, axis)
+    plan = mesh_aligned_plan(n_configs, min_budget, max_budget, eta, n_shards)
+    plans = [plan] * max(int(n_brackets), 1)
+    codec = build_space_codec(configspace)
+    d = int(codec.kind.shape[0])
+    rng = np.random.default_rng(seed)
+    codec_sig = codec.signature
+
+    chunk = len(plans) if chunk_brackets is None else max(int(chunk_brackets), 1)
+    dynamic = chunk_brackets is not None
+    sweep_kwargs: Dict[str, Any] = dict(
+        num_samples=num_samples,
+        mesh=mesh,
+        axis=axis,
+        shard_sampling=True,
+        incumbent_only=True,
+        # HyperBand mode: an unreachable gate keeps the KDE out of the
+        # trace entirely (any_trainable=False) — pure sample/eval/promote
+        min_points_in_model=None if model else 2**30,
+    )
+    caps = None
+    if dynamic:
+        # one capacity map for the WHOLE schedule (pow2, floor 256): every
+        # chunk shares buffer shapes, so the run is one executable and the
+        # threaded state never re-uploads (ops/sweep.py return_state)
+        caps = {
+            float(b): 1 << max(int(n) - 1, 255).bit_length()
+            for b, n in plan_additions(plans).items()
+        }
+
+    def _empty_state_args():
+        """Zero-observation warm buffers, built PER SHARD SLICE via
+        ``make_array_from_callback`` — no host allocation ever holds a
+        full capacity buffer (the bounded-RSS contract the bench tier's
+        RSS probe checks). Returns ``(warm_v, warm_l, warm_n,
+        host_bytes)`` — the bytes the host link actually carries, so the
+        transfer ledger measures the warm upload instead of asserting it
+        (same accounting as ``FusedBOHB._stream_warm_args``)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        shard = batch_sharding(mesh, axis)
+        rep = NamedSharding(mesh, PartitionSpec())
+        warm_v, warm_l, warm_n = {}, {}, {}
+        host_bytes = 0
+        for b, cap in caps.items():
+            sh = shard if cap % n_shards == 0 else rep
+            warm_v[b] = jax.make_array_from_callback(
+                (cap, d), sh,
+                lambda idx, cap=cap: np.zeros(
+                    _slice_shape(idx, (cap, d)), np.float32
+                ),
+            )
+            warm_l[b] = jax.make_array_from_callback(
+                (cap,), sh,
+                lambda idx, cap=cap: np.full(
+                    _slice_shape(idx, (cap,)), np.inf, np.float32
+                ),
+            )
+            warm_n[b] = np.int32(0)
+            host_bytes += cap * d * 4 + cap * 4 + 4
+        return warm_v, warm_l, warm_n, host_bytes
+
+    fns: Dict[int, Any] = {}
+    chunks: List[Dict[str, Any]] = []
+    best: Optional[Dict[str, Any]] = None
+    state = None
+    remaining = list(plans)
+    bracket_base = 0
+    while remaining:
+        chunk_plans, remaining = remaining[:chunk], remaining[chunk:]
+        if len(chunk_plans) not in fns:
+            # process-wide reuse (same policy as the other fused tiers):
+            # bench repeats of the same (objective, schedule, mesh, knobs)
+            # must not retrace/recompile — the compile-count acceptance
+            # (<= one program per chunk shape) is per PROCESS, not per call
+            cache_key = (
+                eval_fn,
+                tuple((p.num_configs, p.budgets) for p in chunk_plans),
+                codec_sig, mesh, axis, bool(model), int(num_samples),
+                dynamic,
+                None if caps is None else tuple(sorted(caps.items())),
+            )
+            cached = _SHARDED_FN_CACHE.get(cache_key)
+            if cached is None:
+                cached = make_fused_sweep_fn(
+                    eval_fn, chunk_plans, codec,
+                    dynamic_counts=dynamic,
+                    capacities=caps,
+                    return_state=dynamic,
+                    **sweep_kwargs,
+                )
+                _SHARDED_FN_CACHE[cache_key] = cached
+            fns[len(chunk_plans)] = cached
+        fn = fns[len(chunk_plans)]
+        seed_val = np.uint32(rng.integers(2**32, dtype=np.uint32))
+        upload_bytes = int(seed_val.nbytes)
+        if dynamic:
+            if state is not None:
+                # device-resident thread: nothing but the seed goes up
+                args = (seed_val,) + state
+            else:
+                warm_v, warm_l, warm_n, host_bytes = _empty_state_args()
+                args = (seed_val, warm_v, warm_l, warm_n)
+                upload_bytes += host_bytes
+        else:
+            args = (seed_val,)
+        note_transfer("h2d", upload_bytes)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        if dynamic:
+            inc, state = out
+        else:
+            inc = out
+        inc = jax.device_get(inc)
+        execute_s = time.perf_counter() - t0
+        note_transfer(
+            "d2h",
+            sum(int(np.asarray(l).nbytes) for l in inc), buffers=len(inc),
+        )
+        loss = float(np.asarray(inc.loss))
+        cand = {
+            "vector": np.asarray(inc.vector, np.float32).tolist(),
+            "loss": loss,
+            "bracket": bracket_base + int(np.asarray(inc.bracket)),
+        }
+        # NaN = every candidate crashed; never beats a real incumbent
+        if best is None or (
+            not np.isnan(loss) and (
+                best["loss"] is None or np.isnan(best["loss"])
+                or loss < best["loss"]
+            )
+        ):
+            best = cand
+        chunks.append({
+            "brackets": len(chunk_plans),
+            "execute_fetch_s": round(execute_s, 4),
+            # 4 bytes (the seed) once the state threads device-to-device
+            "warm_upload_bytes": upload_bytes,
+        })
+        bracket_base += len(chunk_plans)
+
+    # geometry-derived balance: every stage splits its (mesh-aligned) rows
+    # evenly, so shard s owns sum(widths)/S rows per bracket. Every row is
+    # a REAL sampled config (the sweep path samples the full aligned
+    # width — alignment surplus rows are extra exploration, not dead
+    # padding), so pad_rows is 0 here and the surplus over the pure
+    # eta-decay ladder is reported separately, uncounted in configs.
+    pure = []
+    for j in range(len(plan.num_configs)):
+        pure.append(max(int(n_configs * float(eta) ** (-j)), 1))
+    per_shard_rows = sum(plan.num_configs) // n_shards * len(plans)
+    surplus_total = (sum(plan.num_configs) - sum(pure)) * len(plans)
+    per_shard_configs = [per_shard_rows] * n_shards
+    skew = None
+    if publish_gauges:
+        skew = publish_device_balance(
+            mesh, axis, per_shard_configs, [0] * n_shards
+        )
+
+    return {
+        "incumbent": best,
+        "evaluations": int(sum(sum(p.num_configs) for p in plans)),
+        "requested_configs": int(n_configs),
+        "aligned_stage_counts": list(plan.num_configs),
+        "budgets": list(plan.budgets),
+        "n_brackets": len(plans),
+        "n_devices": int(np.asarray(mesh.devices).size),
+        "n_shards": n_shards,
+        "per_device_configs": per_shard_configs,
+        # rows evaluated beyond the pure eta ladder due to mesh alignment
+        # (whole schedule, all shards) — already included in
+        # per_device_configs/evaluations, never add them together
+        "alignment_surplus_rows": int(surplus_total),
+        "balance_skew": 0.0 if skew is None else round(float(skew), 6),
+        "chunks": chunks,
+        "execute_fetch_s": round(
+            sum(c["execute_fetch_s"] for c in chunks), 4
+        ),
+    }
+
+
+def _slice_shape(idx, shape) -> tuple:
+    """Concrete shape of the shard slice ``make_array_from_callback``
+    asks for — the per-shard allocation unit of the streamed uploads."""
+    out = []
+    for sl, n in zip(idx, shape):
+        start, stop, _ = sl.indices(n)
+        out.append(stop - start)
+    return tuple(out)

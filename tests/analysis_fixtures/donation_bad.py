@@ -5,12 +5,22 @@ says nothing about buffer donation — neither donating nor explicitly
 declining."""
 
 import jax
+from jax.experimental.pjit import pjit
 
 from hpbandster_tpu.obs.runtime import tracked_jit
 
 
 def sharded_no_stance(fn, shard):
     return jax.jit(fn, in_shardings=(shard,))  # BAD
+
+
+def pjit_no_stance(fn):
+    # pjit is sharded BY CONSTRUCTION: no sharding kwarg needed to flag
+    return pjit(fn)  # BAD
+
+
+def pjit_sharded_no_stance(fn, shard):
+    return pjit(fn, in_shardings=(shard,))  # BAD
 
 
 def out_sharded_no_stance(fn, rep):

@@ -3,8 +3,18 @@ explicit donation stance (donate, explicitly decline, or carry the
 decision in a **kwargs splat), and unsharded sites are out of scope."""
 
 import jax
+from jax.experimental.pjit import pjit
 
 from hpbandster_tpu.obs.runtime import tracked_jit
+
+
+def pjit_declining(fn):
+    # sharded by construction; considered and declined
+    return pjit(fn, donate_argnums=())
+
+
+def pjit_donating(fn, shard):
+    return pjit(fn, in_shardings=(shard,), donate_argnums=(0,))
 
 
 def sharded_donating(fn, shard):

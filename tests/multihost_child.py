@@ -148,6 +148,34 @@ def main() -> None:
         json.dump(fruns, f)
     print(f"proc {proc_id}: fused OK ({len(fruns)} runs)")
 
+    # ---- phase 3 (ISSUE 10): the mesh-SHARDED incumbent-only sweep over
+    # the pod — per-shard sampling over the pod-wide config axis, rung
+    # reductions over ICI/DCN, and ONLY the final incumbent (replicated)
+    # leaving the device loop. Every rank must fetch the identical
+    # incumbent, and each rank publishes balance gauges for its own
+    # local devices only.
+    from hpbandster_tpu.obs.metrics import get_metrics
+    from hpbandster_tpu.parallel.mesh import is_multiprocess_mesh
+
+    assert is_multiprocess_mesh(mesh)
+    sharded = executor.run_sharded_sweep(
+        n_configs=64, eval_fn=branin_from_vector, mesh=mesh, seed=4,
+        max_budget=9.0,
+    )
+    assert sharded["n_shards"] == len(devices)
+    gauges = get_metrics().snapshot()["gauges"]
+    local_ids = {
+        d.id for d in devices if d.process_index == jax.process_index()
+    }
+    published = {
+        int(k.split(".")[2]) for k in gauges
+        if k.startswith("sweep.device.") and k.endswith(".configs")
+    }
+    assert published == local_ids, (published, local_ids)
+    with open(os.path.join(outdir, f"sharded_{proc_id}.json"), "w") as f:
+        json.dump(sharded["incumbent"], f)
+    print(f"proc {proc_id}: sharded OK (loss {sharded['incumbent']['loss']})")
+
 
 if __name__ == "__main__":
     main()

@@ -61,6 +61,33 @@ class TestRuleFixtures:
         assert findings == [], "\n".join(str(f) for f in findings)
 
 
+class TestDonationPjitResolution:
+    """The pjit extension must not mistake a module-local helper named
+    ``pjit`` for the jax boundary (unconditional flagging requires the
+    fully-qualified resolution); a bare pjit still gets the
+    kwarg-triggered check like the other bare wrapper names."""
+
+    def test_local_pjit_helper_not_flagged(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text(
+            "def pjit(fn):\n"
+            "    return fn\n"
+            "\n"
+            "def use(fn):\n"
+            "    return pjit(fn)\n"
+        )
+        assert run([str(mod)], rules=["jit-donation"]) == []
+
+    def test_bare_pjit_with_sharding_kwarg_still_flagged(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text(
+            "def use(fn, pjit, shard):\n"
+            "    return pjit(fn, in_shardings=(shard,))\n"
+        )
+        findings = run([str(mod)], rules=["jit-donation"])
+        assert len(findings) == 1
+
+
 class TestSuppressions:
     def test_standalone_comment_suppresses_next_line(self, tmp_path):
         mod = tmp_path / "mod.py"

@@ -92,7 +92,46 @@ class TestDeriveFleet:
         fleet = derive_fleet({}, ok=0, stale=0, lost=0, churn_events=0)
         assert fleet["endpoints"] == 0
         assert fleet["device_mem_skew"] is None
+        assert fleet["device_compute_skew"] is None
         assert fleet["workers_alive"] is None
+
+    def test_device_compute_skew_from_sweep_gauges(self):
+        """The compute-balance sibling of the memory skew: worst
+        PER-ENDPOINT (max-min)/max over per-device sharded-sweep config
+        counts."""
+        rows = self.rows()
+        rows["w"]["sweep_devices"] = {
+            "0": {"configs": 100.0, "pad_rows": 0.0},
+            "1": {"configs": 50.0, "pad_rows": 0.0},  # uneven endpoint
+        }
+        rows["h2"] = {
+            "ok": True, "component": "worker", "devices": {},
+            "sweep_devices": {"2": {"configs": 7.0}, "3": {"configs": 7.0}},
+        }
+        fleet = derive_fleet(rows, ok=3, stale=0, lost=0, churn_events=0)
+        assert fleet["device_compute_skew"] == 0.5
+        # two BALANCED sweeps of very different sizes must read 0.0:
+        # absolute counts are only comparable within one sweep, never
+        # pooled across endpoints
+        rows["w"]["sweep_devices"]["1"]["configs"] = 100.0
+        fleet = derive_fleet(rows, ok=3, stale=0, lost=0, churn_events=0)
+        assert fleet["device_compute_skew"] == 0.0
+
+    def test_endpoint_row_distills_sweep_device_gauges(self):
+        from hpbandster_tpu.obs.collector import _endpoint_row
+
+        snap = snap_of(gauges={
+            "sweep.device.0.configs": 186.0,
+            "sweep.device.0.pad_rows": 1.0,
+            "sweep.device.3.configs": 186.0,
+            "sweep.balance_skew": 0.0,  # not a per-device gauge: ignored
+            "dispatcher.queue_depth": 2.0,
+        })
+        row = _endpoint_row(snap)
+        assert row["sweep_devices"] == {
+            "0": {"configs": 186.0, "pad_rows": 1.0},
+            "3": {"configs": 186.0},
+        }
 
 
 class FakeFetch:

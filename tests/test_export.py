@@ -319,6 +319,60 @@ class TestRooflineFamilies:
             (labels["fn"], value) for labels, value in nbytes["samples"]
         ) == {"fused_sh_bracket_bucketed": 49152.0}
 
+    def test_sweep_device_family_is_labeled(self):
+        """ISSUE 10 satellite: the per-device sharded-sweep balance
+        gauges export as a device-labeled family."""
+        fam, labels = metric_family("sweep.device.3.configs")
+        assert fam == "hpbandster_sweep_device_configs"
+        assert labels == {"device": "3"}
+        fam, labels = metric_family("sweep.device.11.pad_rows")
+        assert fam == "hpbandster_sweep_device_pad_rows"
+        assert labels == {"device": "11"}
+        # the derived fleet skew stays an unlabeled gauge
+        fam, labels = metric_family("fleet.device_compute_skew")
+        assert fam == "hpbandster_fleet_device_compute_skew"
+        assert labels == {}
+
+    def test_sweep_device_round_trip(self):
+        reg = obs.MetricsRegistry()
+        reg.gauge("sweep.device.0.configs").set(186.0)
+        reg.gauge("sweep.device.7.configs").set(186.0)
+        reg.gauge("sweep.device.7.pad_rows").set(1.0)
+        reg.gauge("sweep.balance_skew").set(0.0)
+        families = parse_prometheus_text(render_registry(reg))
+        configs = families["hpbandster_sweep_device_configs"]
+        assert configs["type"] == "gauge"
+        assert {
+            labels["device"]: value for labels, value in configs["samples"]
+        } == {"0": 186.0, "7": 186.0}
+        pads = families["hpbandster_sweep_device_pad_rows"]
+        assert [(dict(l), v) for l, v in pads["samples"]] == [
+            ({"device": "7"}, 1.0)
+        ]
+        assert families["hpbandster_sweep_balance_skew"]["samples"] == [
+            ({}, 0.0)
+        ]
+
+    def test_publish_to_scrape_end_to_end(self):
+        """publish_device_balance -> process registry -> scrape: the
+        driver's gauges reach a scraper with no extra wiring."""
+        import jax
+
+        from hpbandster_tpu.obs.metrics import get_metrics
+        from hpbandster_tpu.parallel.mesh import config_mesh
+        from hpbandster_tpu.parallel.multihost import publish_device_balance
+
+        mesh = config_mesh(jax.devices()[:2])
+        publish_device_balance(mesh, "config", [64, 32], [0, 4])
+        families = parse_prometheus_text(render_registry(get_metrics()))
+        configs = families["hpbandster_sweep_device_configs"]
+        by_dev = {l["device"]: v for l, v in configs["samples"]}
+        ids = [str(d.id) for d in jax.devices()[:2]]
+        assert by_dev[ids[0]] == 64.0 and by_dev[ids[1]] == 32.0
+        assert families["hpbandster_sweep_balance_skew"]["samples"] == [
+            ({}, 0.5)
+        ]
+
     def test_aot_ledger_to_scrape_end_to_end(self):
         """A tracked AOT compile lands its cost in the scrape with no
         extra wiring."""

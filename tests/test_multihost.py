@@ -73,6 +73,16 @@ def test_two_process_spmd_bohb(tmp_path):
     assert len(fused0) > 0
     assert fused0 == fused1
 
+    # mesh-sharded incumbent-only sweep (ISSUE 10): both ranks ran the
+    # sharded sweep over the pod mesh and fetched the IDENTICAL incumbent
+    # — only the winner left the device loop
+    with open(tmp_path / "sharded_0.json") as f:
+        sharded0 = json.load(f)
+    with open(tmp_path / "sharded_1.json") as f:
+        sharded1 = json.load(f)
+    assert sharded0 == sharded1
+    assert sharded0["loss"] is not None
+
     # only process 0 logs: the logger dir exists (created by proc 0) and
     # nothing else in outdir beyond it and the run dumps
     logged = tmp_path / "logged"
@@ -82,4 +92,5 @@ def test_two_process_spmd_bohb(tmp_path):
     assert entries == [
         "fused_runs_0.json", "fused_runs_1.json",
         "logged", "runs_0.json", "runs_1.json",
+        "sharded_0.json", "sharded_1.json",
     ]
