@@ -65,7 +65,8 @@ HEADLINE_BRACKETS = 27
 TIER_ORDER = (
     "cnn", "cnn_wide", "pallas", "resnet", "transformer", "fused_1M",
     "fused_100k", "fused10k", "chunked10k", "chunked_compile", "fused",
-    "rpc", "batched", "teacher", "multitenant", "chaos", "obs_overhead",
+    "rpc", "batched", "teacher", "multitenant", "chaos",
+    "async_straggler", "obs_overhead",
     "runtime_overhead", "collector_overhead", "report_100k",
 )
 
@@ -1510,6 +1511,175 @@ def bench_chaos(n_workers=4, n_iterations=3, seed=0, repeats=3,
     }
 
 
+def bench_async_straggler(n_workers=3, n_iterations=2, seed=0, repeats=3,
+                          compute_s_per_budget=0.004, straggler_s=0.35,
+                          straggler_min_samples=4):
+    """Async-promotion tier: what the rung barrier costs under one
+    straggler, and what ASHA buys back (docs/promotion.md).
+
+    Paired seeded sweeps over the real host pool (nameserver +
+    dispatcher + ``n_workers`` socket workers), one worker injected as a
+    straggler: its compute sleeps ``straggler_s`` extra per evaluation —
+    the one-host-quietly-10x-slower shape the anomaly detector's
+    straggler rule flags. (The injection sits in compute, not on the
+    RPC path: a chaos-proxy delay fault serializes through the
+    dispatcher's single dispatch loop and would stall BOTH arms equally
+    — head-of-line, not the barrier.) Each seed runs the same sweep
+    twice: the paper's synchronous successive-halving barrier, then
+    ``promotion_rule="asha"``. Both journal, and both pay the identical
+    worker pacing, so the deltas isolate the promotion rule:
+
+    * ``barrier_stall_s`` — max seconds a promoted config sat between
+      its rung result and the decision that promoted it
+      (``promote.replay.promotion_waits``): the barrier made
+      measurable. Sync pays ~``straggler_s`` per stalled rung; ASHA's
+      stays near zero;
+    * ``utilization_delta`` — fleet busy-fraction (ASHA - sync) from
+      the journals' run spans: what the idle wait cost the pool;
+    * ``throughput_ratio`` — ASHA configs/s over sync configs/s
+      (paired seeds, medians);
+    * ``straggler_markers`` — ``straggler_observed`` entries on the
+      recorded promotion decisions (the anomaly -> audit loop,
+      threshold lowered to fire on bench-scale rungs).
+
+    Host-side sockets + a python objective: no device compiles, so the
+    tier regenerates on the CPU fallback path like the obs tiers.
+    """
+    import tempfile
+
+    from hpbandster_tpu import obs
+    from hpbandster_tpu.core.nameserver import NameServer
+    from hpbandster_tpu.core.worker import Worker
+    from hpbandster_tpu.obs.anomaly import AnomalyRules
+    from hpbandster_tpu.optimizers import BOHB
+    from hpbandster_tpu.parallel.dispatcher import Dispatcher
+    from hpbandster_tpu.promote.replay import (
+        promotion_waits,
+        worker_utilization,
+    )
+    from hpbandster_tpu.workloads.toys import branin_dict, branin_space
+
+    class PacedWorker(Worker):
+        straggle_s = 0.0
+
+        def compute(self, config_id, config, budget, working_directory):
+            time.sleep(compute_s_per_budget * float(budget) + self.straggle_s)
+            return {"loss": branin_dict(config, budget), "info": {}}
+
+    def run_once(s, rule):
+        run_id = f"bench-straggler-{s}-{rule or 'sync'}"
+        journal = os.path.join(
+            tempfile.mkdtemp(prefix="bench_straggler_"), "journal.jsonl"
+        )
+        handle = obs.configure(
+            journal_path=journal,
+            anomaly=AnomalyRules(
+                straggler_min_samples=straggler_min_samples,
+                straggler_factor=2.0, cooldown_s=0.0,
+            ),
+        )
+        ns = NameServer(run_id=run_id, host="127.0.0.1", port=0)
+        host, port = ns.start()
+        opt = None
+        try:
+            for i in range(n_workers):
+                w = PacedWorker(
+                    run_id=run_id, nameserver=host, nameserver_port=port,
+                    id=i,
+                )
+                if i == 0:  # the injected straggler
+                    w.straggle_s = straggler_s
+                w.run(background=True)
+            d = Dispatcher(
+                run_id=run_id, nameserver=host, nameserver_port=port,
+                ping_interval=0.1, discover_interval=0.1,
+            )
+            opt = BOHB(
+                configspace=branin_space(seed=s), run_id=run_id,
+                executor=d, min_budget=1, max_budget=9, eta=3, seed=s,
+                min_points_in_model=10_000,  # pure seeded sampling
+                promotion_rule=rule,
+            )
+            t0 = time.perf_counter()
+            res = opt.run(n_iterations=n_iterations, min_n_workers=n_workers)
+            dt = time.perf_counter() - t0
+            n_runs = len(res.get_all_runs())
+            incumbent = res.get_incumbent_id()
+        finally:
+            if opt is not None:
+                opt.shutdown(shutdown_workers=True)
+            ns.shutdown()
+            handle.close()
+        records = obs.read_journal(journal)
+        waits = promotion_waits(records)
+        util = worker_utilization(records)
+        stragglers = sum(
+            len(r.get("straggler_observed") or [])
+            for r in records if r.get("event") == "promotion_decision"
+        )
+        return {
+            "rate": n_runs / dt,
+            "incumbent": incumbent,
+            "stall_s": waits["max_wait_s"] or 0.0,
+            "mean_wait_s": waits["mean_wait_s"] or 0.0,
+            "busy_fraction": util["busy_fraction"],
+            "straggler_markers": stragglers,
+        }
+
+    sync_rates, asha_rates = [], []
+    sync_stalls, asha_stalls = [], []
+    util_deltas, markers = [], 0
+    for i in range(repeats):
+        s = seed + i
+        sync = run_once(s, None)
+        asha = run_once(s, "asha")
+        sync_rates.append(sync["rate"])
+        asha_rates.append(asha["rate"])
+        sync_stalls.append(sync["stall_s"])
+        asha_stalls.append(asha["stall_s"])
+        if (
+            sync["busy_fraction"] is not None
+            and asha["busy_fraction"] is not None
+        ):
+            util_deltas.append(asha["busy_fraction"] - sync["busy_fraction"])
+        markers += sync["straggler_markers"] + asha["straggler_markers"]
+    def summarize(rates):
+        # the smoke lane runs a single pair; an IQR from < 3 runs would
+        # masquerade as spread, so it reports median-only there
+        if len(rates) >= 3:
+            return _summary(rates)
+        return {
+            "median": round(statistics.median(rates), 2),
+            "iqr": None,
+            "runs_configs_per_s": [round(r, 2) for r in sorted(rates)],
+        }
+
+    sync_summary = summarize(sync_rates)
+    asha_summary = summarize(asha_rates)
+    return {
+        "n_workers": n_workers,
+        "n_iterations": n_iterations,
+        "straggler_s": straggler_s,
+        "median": asha_summary["median"],
+        "iqr": asha_summary["iqr"],
+        "runs_configs_per_s": asha_summary["runs_configs_per_s"],
+        "sync": sync_summary,
+        "throughput_ratio": (
+            round(asha_summary["median"] / sync_summary["median"], 3)
+            if sync_summary["median"] else None
+        ),
+        "barrier_stall_s": {
+            "sync_median": round(statistics.median(sync_stalls), 4),
+            "asha_median": round(statistics.median(asha_stalls), 4),
+        },
+        "utilization_delta": (
+            round(sum(util_deltas) / len(util_deltas), 4)
+            if util_deltas else None
+        ),
+        "straggler_markers": markers,
+    }
+
+
 def bench_report_100k(n_events=100_000, seed=0):
     """Report-CLI throughput over a synthetic ``n_events``-line journal.
 
@@ -1670,6 +1840,10 @@ TIER_BUDGETS = {
     # recovery machinery must cost (near) zero device work; a compile
     # appearing here means chaos plumbing leaked onto the device path
     "chaos":           {"max_compiles": 4,  "max_transfer_mb": 8},
+    # async-promotion tier: same host-socket diet as chaos — promotion
+    # bookkeeping is pure host work, so a compile here means a rule
+    # implementation dragged device code into the master loop
+    "async_straggler": {"max_compiles": 4,  "max_transfer_mb": 8},
 }
 
 
@@ -1870,6 +2044,9 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
         chaos = emit("chaos", _run_tier(
             errors, "chaos", bench_chaos,
             n_workers=2, n_iterations=1, repeats=repeats))
+        async_straggler = emit("async_straggler", _run_tier(
+            errors, "async_straggler", bench_async_straggler,
+            n_workers=2, n_iterations=1, repeats=1))
         obs_overhead = emit("obs_overhead", _run_tier(
             errors, "obs_overhead", bench_obs_overhead, repeats=repeats))
         runtime_overhead = emit("runtime_overhead", _run_tier(
@@ -2059,6 +2236,12 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
                  _run_tier(errors, "chaos", bench_chaos, repeats=repeats))
             if selected("chaos") else dict(NOT_SELECTED)
         )
+        async_straggler = (
+            emit("async_straggler",
+                 _run_tier(errors, "async_straggler",
+                           bench_async_straggler, repeats=repeats))
+            if selected("async_straggler") else dict(NOT_SELECTED)
+        )
         # backend-independent (the obs layer is host-side either way) and
         # seconds-scale on CPU, so it measures even on the fallback path —
         # the overhead claim in docs/observability.md regenerates anywhere
@@ -2181,6 +2364,7 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
             "chunked10k_at_scale_36_brackets_1_729": chunked10k,
             "multitenant_serving_16_tenants": multitenant,
             "chaos_churn_10pct": chaos,
+            "async_straggler_promotion": async_straggler,
             "obs_overhead_no_sink": obs_overhead,
             "runtime_overhead_tracked_jit": runtime_overhead,
             "collector_overhead_fleet_poll": collector_overhead,

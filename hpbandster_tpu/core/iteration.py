@@ -114,6 +114,10 @@ class BaseIteration:
         #: losses (H2BO extrapolation) stashes its per-candidate scores
         #: here from _advance_to_next_stage; they ride the audit record
         self.last_promotion_scores: Optional[List[Optional[float]]] = None
+        #: multi-objective rules (promote/pareto.py) additionally stash
+        #: the per-candidate Pareto domination counts here — the audit
+        #: record then shows the front structure the decision ranked by
+        self.last_pareto_ranks: Optional[List[Optional[int]]] = None
 
     # ------------------------------------------------------------- properties
     @property
@@ -269,6 +273,7 @@ class BaseIteration:
             return True
 
         self.last_promotion_scores = None
+        self.last_pareto_ranks = None
         advance = self._advance_to_next_stage(config_ids, losses)
         rung = self.stage
         self.stage += 1
@@ -284,14 +289,14 @@ class BaseIteration:
                     Status.CRASHED if d.results.get(budget) is None
                     else Status.TERMINATED
                 )
-        obs.emit(
-            obs.BRACKET_PROMOTION,
-            iteration=self.HPB_iter, stage=self.stage,
+        obs.emit_bracket_promotion(
+            self.HPB_iter, rung, self.promotion_rule,
             promoted=int(np.sum(advance)), candidates=len(config_ids),
             budget=budget, next_budget=next_budget,
         )
         # the audit twin: full per-candidate detail (losses, mask, cut
-        # threshold, rule scores) — what report's regret table replays
+        # threshold, rule scores, measured costs) — what report's regret
+        # table and the promote/replay.py harness re-score
         obs.emit_promotion_decision(
             self.HPB_iter, rung, budget, next_budget,
             config_ids=config_ids,
@@ -299,8 +304,17 @@ class BaseIteration:
             promoted=[bool(a) for a in advance],
             rule=self.promotion_rule,
             scores=self.last_promotion_scores,
+            pareto_rank=self.last_pareto_ranks,
+            # bus-gated: the emitter discards everything when no sink is
+            # attached, so the O(n) cost measurement must not be paid
+            # eagerly on the no-sink fast path
+            costs=(
+                [self.promotion_cost(cid, budget) for cid in config_ids]
+                if obs.get_bus().active else None
+            ),
         )
         self.last_promotion_scores = None
+        self.last_pareto_ranks = None
         self.logger.debug(
             "iteration %d advanced to stage %d (%d promoted)",
             self.HPB_iter, self.stage, int(np.sum(advance)),
@@ -312,6 +326,44 @@ class BaseIteration:
     ) -> np.ndarray:
         """bool[n] promotion mask — implemented by subclasses."""
         raise NotImplementedError
+
+    def measured_cost(
+        self, config_id: ConfigId, budget: float
+    ) -> Optional[float]:
+        """Measured evaluation cost (seconds) of one config at one rung,
+        or None when unmeasured.
+
+        Priority: an explicit ``cost`` the evaluation reported in its
+        info payload (a worker measuring device time, not wall), then the
+        started->finished wall span the job's timestamp schema already
+        records. This is the cost column multi-objective promotion ranks
+        (promote/pareto.py) and what rides ``promotion_decision.costs``
+        so a recorded journal stays Pareto-replayable.
+        """
+        d = self.data.get(config_id)
+        if d is None:
+            return None
+        info = d.infos.get(budget)
+        if isinstance(info, dict):
+            cost = info.get("cost")
+            if isinstance(cost, (int, float)) and np.isfinite(cost):
+                return float(cost)
+        ts = d.time_stamps.get(budget) or {}
+        try:
+            span = float(ts["finished"]) - float(ts["started"])
+        except (KeyError, TypeError, ValueError):
+            return None
+        return span if np.isfinite(span) and span >= 0 else None
+
+    def promotion_cost(
+        self, config_id: ConfigId, budget: float
+    ) -> Optional[float]:
+        """The cost column the audit record journals. Default: the
+        measured cost. A rule ranking by a custom cost (ParetoIteration's
+        ``cost_fn``) overrides this so ``promotion_decision.costs``
+        carries the numbers the decision ACTUALLY used — the replay
+        harness's Pareto re-scoring depends on that fidelity."""
+        return self.measured_cost(config_id, budget)
 
     # ------------------------------------------------------- array interface
     def loss_matrix(self) -> Tuple[List[ConfigId], np.ndarray]:

@@ -265,8 +265,13 @@ class Master:
             # quantiles visible over RPC with no journal on disk
             obs.get_metrics().histogram("master.job_run_s").observe(run_s)
         # the tenant wrap covers the bracket bookkeeping too: promotion /
-        # audit events emitted by process_results() carry the stamp
-        with obs.use_tenant(self.tenant_id), self.thread_cond:
+        # audit events emitted by process_results() carry the stamp; the
+        # run wrap scopes the straggler-ledger drain (obs/audit.py) to
+        # THIS sweep — config-id triples restart every run, so an
+        # unscoped drain could absorb a finished sweep's markers
+        with obs.use_tenant(self.tenant_id), obs.use_run(
+            self.run_id
+        ), self.thread_cond:
             self.num_running_jobs -= 1
             if self._wal is not None:
                 # write-ahead: on disk before any in-memory consumption,
@@ -331,6 +336,20 @@ class Master:
 
     def active_iterations(self) -> List[int]:
         return [i for i, it in enumerate(self.iterations) if not it.is_finished]
+
+    def best_loss_at(self, budget: float) -> Optional[float]:
+        """Best (lowest) recorded loss at ``budget`` across every bracket
+        so far, or None — the sweep-wide incumbent reader promotion rules
+        use as their early-stopping cut (promote/earlystop.py). Callers
+        run inside the result-ingestion path, which already holds the
+        master lock; the read is plain dict traversal either way."""
+        best: Optional[float] = None
+        for it in self.iterations:
+            for d in it.data.values():
+                v = d.results.get(budget)
+                if v is not None and (best is None or v < best):
+                    best = float(v)
+        return best
 
     def wait_for_workers(self, min_n_workers: int) -> None:
         while self.executor.number_of_workers() < min_n_workers:

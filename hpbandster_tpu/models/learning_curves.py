@@ -17,9 +17,28 @@ from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["LastValueModel", "PowerLawModel"]
+__all__ = ["LastValueModel", "PowerLawModel", "clean_curve"]
 
 Curve = Sequence[Tuple[float, float]]  # [(budget, loss), ...]
+
+
+def clean_curve(curve: Curve) -> List[Tuple[float, float]]:
+    """Budget-sorted curve with non-finite points dropped.
+
+    The models' shared degenerate-input contract (the early-stopping
+    promotion rule feeds curves straight from crash-NaN-masked bracket
+    state): NaN/inf losses and budgets are not observations — they are
+    crash markers — so they never enter a fit. Duplicate budgets keep
+    their relative order (stable sort on budget only): the later record
+    of a re-evaluated rung stays the later point.
+    """
+    pts = [
+        (float(b), float(v))
+        for b, v in curve
+        if np.isfinite(b) and np.isfinite(v)
+    ]
+    pts.sort(key=lambda p: p[0])
+    return pts
 
 
 class LastValueModel:
@@ -29,9 +48,10 @@ class LastValueModel:
         return self
 
     def predict(self, curve: Curve, target_budget: float) -> float:
-        if not curve:
+        pts = clean_curve(curve)
+        if not pts:
             return float("nan")
-        return float(sorted(curve)[-1][1])
+        return pts[-1][1]
 
 
 class PowerLawModel:
@@ -56,9 +76,9 @@ class PowerLawModel:
         return self
 
     def predict(self, curve: Curve, target_budget: float) -> float:
-        pts = sorted(curve)
+        pts = clean_curve(curve)
         if len(pts) < 3:
-            return LastValueModel().predict(curve, target_budget)
+            return LastValueModel().predict(pts, target_budget)
         b = np.array([p[0] for p in pts], dtype=np.float64)
         y = np.array([p[1] for p in pts], dtype=np.float64)
         # asymptote estimate from the last three points: on a geometric

@@ -83,10 +83,14 @@ from hpbandster_tpu.obs.collector import (  # noqa: F401
 )
 from hpbandster_tpu.obs.audit import (  # noqa: F401
     AUDIT_EVENTS,
+    AUDIT_RULE_FIELDS,
     config_lineage,
+    drain_stragglers,
     emit_bracket_created,
+    emit_bracket_promotion,
     emit_config_sampled,
     emit_promotion_decision,
+    note_straggler,
 )
 from hpbandster_tpu.obs.events import (  # noqa: F401
     ALERT,
@@ -161,12 +165,14 @@ from hpbandster_tpu.obs.runtime import (  # noqa: F401
 from hpbandster_tpu.obs.trace import (  # noqa: F401
     DEFAULT_TENANT,
     TraceContext,
+    current_run,
     current_tenant,
     current_trace,
     current_wire,
     extract_tenant,
     extract_wire,
     new_trace,
+    use_run,
     use_tenant,
     use_trace,
 )
@@ -179,10 +185,13 @@ __all__ = [
     "TraceContext", "new_trace", "current_trace", "use_trace",
     "current_wire", "extract_wire",
     "DEFAULT_TENANT", "current_tenant", "use_tenant", "extract_tenant",
+    "current_run", "use_run",
     "HealthEndpoint", "install_crash_dump",
     "AnomalyDetector", "AnomalyRules", "scan_records",
-    "AUDIT_EVENTS", "config_lineage", "emit_bracket_created",
+    "AUDIT_EVENTS", "AUDIT_RULE_FIELDS", "config_lineage",
+    "emit_bracket_created", "emit_bracket_promotion",
     "emit_config_sampled", "emit_promotion_decision",
+    "note_straggler", "drain_stragglers",
     "CompileTracker", "DeviceSampler", "get_compile_tracker",
     "note_transfer", "runtime_snapshot", "start_device_sampler",
     "tracked_jit",

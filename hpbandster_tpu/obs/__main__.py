@@ -273,6 +273,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="report one tenant's slice of a multi-tenant journal "
         "(records without tenant_id belong to 'default')",
     )
+    p_rpl = sub.add_parser(
+        "replay",
+        help="re-score recorded promotion_decision records under another "
+        "promotion rule: rank-inversion and incumbent-regret deltas "
+        "(deterministic; see docs/promotion.md)",
+    )
+    p_rpl.add_argument(
+        "journals", nargs="+", metavar="journal",
+        help="JSONL run journal(s) — merged before analysis",
+    )
+    p_rpl.add_argument(
+        "--rule", required=True, metavar="RULE",
+        help="promotion rule to replay under (e.g. asha, pareto, "
+        "lc_earlystop, successive_halving)",
+    )
+    p_rpl.add_argument(
+        "--eta", type=float, default=None,
+        help="eta for the asha replay (default: derived from each "
+        "record's budget ratio)",
+    )
+    p_rpl.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the replay report as JSON instead of text",
+    )
     p_watch = sub.add_parser(
         "watch", help="tail a live journal (or poll a health RPC), "
         "one status line per tick"
@@ -401,6 +425,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     records = _read_checked(args.journals)
     if records is None:
         return 2
+    if args.command == "replay":
+        # CLI-only import: the replay harness pulls in the promotion
+        # kernels (numpy/jax); the substrate commands stay stdlib-only
+        from hpbandster_tpu.promote.replay import (
+            format_replay,
+            replay_records,
+        )
+
+        try:
+            rep = replay_records(records, args.rule, eta=args.eta)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if args.as_json:
+            print(json.dumps(rep, indent=1, sort_keys=True))
+        else:
+            print(format_replay(rep))
+        return 0
     if args.command == "report":
         if args.tenant is not None:
             records = filter_tenant(records, args.tenant)

@@ -191,6 +191,21 @@ class AnomalyDetector:
         if last is not None and now - last < self.rules.cooldown_s:
             return None
         self._last_alert[key] = now
+        if (
+            rule == "straggler"
+            and self._bus is not None  # offline replays must not pollute
+            # the process ledger with a foreign journal's config ids
+            and detail.get("config_id") is not None
+        ):
+            # close the anomaly -> scheduler loop: the flagged config id
+            # rides its rung's next promotion_decision record as
+            # `straggler_observed` (obs/audit.py ledger), so replays can
+            # correlate stalls with promotion timing
+            from hpbandster_tpu.obs.audit import note_straggler
+
+            note_straggler(
+                detail.get("config_id"), budget=rec.get("budget")
+            )
         alert = {
             "event": E.ALERT,
             "t_wall": now,

@@ -34,22 +34,38 @@ unchanged: audit call sites never stamp ``trace_id``/``host`` by hand.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+import collections
+import threading
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 from hpbandster_tpu.obs import events as E
 
 __all__ = [
     "AUDIT_EVENTS",
     "SAMPLING_INFO_KEYS",
+    "AUDIT_RULE_FIELDS",
     "emit_bracket_created",
+    "emit_bracket_promotion",
     "emit_config_sampled",
     "emit_promotion_decision",
+    "note_straggler",
+    "drain_stragglers",
     "config_key",
     "config_lineage",
 ]
 
 #: the audit vocabulary (subset of ``obs.EVENT_TYPES``)
 AUDIT_EVENTS = frozenset({E.CONFIG_SAMPLED, E.PROMOTION_DECISION})
+
+#: promotion-audit field names only the dedicated emitters below may
+#: stamp (the ``obs-reserved-fields`` graftlint rule enforces it for
+#: generic ``emit``/``span`` call sites outside the obs substrate): the
+#: active promotion rule and rung, the Pareto ranking a multi-objective
+#: decision ranked by, and the straggler correlation marker. An ad-hoc
+#: emitter inventing any of these would corrupt the replay/regret join.
+AUDIT_RULE_FIELDS = frozenset(
+    {"rule", "rung", "pareto_rank", "straggler_observed"}
+)
 
 #: config-generator info keys copied into the ``config_sampled`` record.
 #: Generators attach these to the info dict they already return (the dict
@@ -86,6 +102,121 @@ def emit_bracket_created(
     )
 
 
+# -------------------------------------------------------- straggler ledger
+#: (run, tenant, config id) triples the anomaly detector's straggler
+#: rule flagged, awaiting their rung's next promotion decision (bounded:
+#: a run that never promotes must not grow this without limit). The
+#: ledger is process-global, so entries are SCOPED by the ambient run
+#: (``obs.use_run`` — the master wraps its ingestion path; sinks fall
+#: back to the job trace's run_id) and tenant: config-id triples restart
+#: at (0, 0, 0) every sweep, and without the scope a marker from one
+#: finished sweep — or a concurrent tenant's — would drain into an
+#: unrelated decision. Guarded by _STRAGGLER_LOCK — the detector fires
+#: from whatever thread emitted the slow event while the master's
+#: bookkeeping thread drains.
+_StragglerEntry = Tuple[
+    Optional[str], Optional[str], Optional[float], Tuple[int, ...]
+]
+_STRAGGLER_LEDGER: Deque[_StragglerEntry] = collections.deque(maxlen=512)
+_STRAGGLER_LOCK = threading.Lock()
+
+
+def _straggler_scope() -> Tuple[Optional[str], Optional[str]]:
+    from hpbandster_tpu.obs.trace import current_run, current_tenant
+
+    return current_run(), current_tenant()
+
+
+def note_straggler(config_id: Any, budget: Optional[float] = None) -> None:
+    """Record a straggler verdict against ``config_id`` (called by the
+    anomaly detector when its straggler rule fires on a job event). The
+    id joins that rung's next ``promotion_decision`` record — same run,
+    tenant, and (when known) the budget the slow evaluation ran at — as
+    a ``straggler_observed`` entry, closing the anomaly -> scheduler
+    loop one notch: replays can correlate stalls with promotion timing.
+    The budget matters under async rules: a config promoted from rung 0
+    and flagged while running at budget 3 appears in BOTH rungs'
+    candidate censuses, and the marker belongs on the rung that actually
+    stalled."""
+    key = config_key(config_id)
+    if key is None:
+        return
+    budget = (
+        float(budget) if isinstance(budget, (int, float)) else None
+    )
+    entry = (*_straggler_scope(), budget, key)
+    with _STRAGGLER_LOCK:
+        if entry not in _STRAGGLER_LEDGER:
+            _STRAGGLER_LEDGER.append(entry)
+
+
+def drain_stragglers(
+    config_ids: Sequence[Sequence[int]],
+    budget: Optional[float] = None,
+) -> List[Tuple[int, ...]]:
+    """Flagged ids among ``config_ids`` in the current run/tenant scope
+    at ``budget``, removed from the ledger (each straggler verdict rides
+    exactly one promotion record). Ids flagged for other rungs — or
+    other runs or tenants — stay queued for their own decision. A
+    budget of None on either side is a wildcard (hand-rolled notes and
+    foreign journals without budget fields still correlate)."""
+    keys = {config_key(cid) for cid in config_ids}
+    keys.discard(None)
+    run, tenant = _straggler_scope()
+    budget = (
+        float(budget) if isinstance(budget, (int, float)) else None
+    )
+    with _STRAGGLER_LOCK:
+        matched = [
+            e for e in _STRAGGLER_LEDGER
+            if e[0] == run and e[1] == tenant and e[3] in keys
+            and (e[2] is None or budget is None or e[2] == budget)
+        ]
+        for e in matched:
+            _STRAGGLER_LEDGER.remove(e)
+    return [e[3] for e in matched]
+
+
+def emit_bracket_promotion(
+    iteration: int,
+    rung: int,
+    rule: str,
+    promoted: int,
+    candidates: int,
+    budget: float,
+    next_budget: Optional[float],
+) -> None:
+    """One ``bracket_promotion`` event stamped with the active promotion
+    rule and rung — the single emitter every promotion tier calls, so the
+    labeled Prometheus family and the journal event cannot drift.
+
+    Beside the event, the ``bracket.promotions.<rule>.<rung>`` counter
+    advances by the promoted-config count; ``obs/export.py`` renders it
+    as ``bracket_promotions_total{rule=..., rung=...}``. The counter
+    advances even with no bus sink (metrics are always-on, like every
+    other registry family); the event costs ~nothing unheard.
+    """
+    from hpbandster_tpu.obs.metrics import get_metrics
+
+    get_metrics().counter(
+        f"bracket.promotions.{rule}.{int(rung)}"
+    ).inc(int(promoted))
+    E.emit(
+        E.BRACKET_PROMOTION,
+        iteration=int(iteration),
+        # `stage` keeps the historical meaning (the stage being ENTERED)
+        # so pre-existing journal readers stay correct; `rung` is the
+        # stage the decision ranked (= stage - 1 for sync advancement)
+        stage=int(rung) + 1,
+        rung=int(rung),
+        rule=rule,
+        promoted=int(promoted),
+        candidates=int(candidates),
+        budget=budget,
+        next_budget=next_budget,
+    )
+
+
 def emit_config_sampled(
     config_id: Sequence[int],
     budget: float,
@@ -119,13 +250,21 @@ def emit_promotion_decision(
     promoted: Sequence[bool],
     rule: str = "successive_halving",
     scores: Optional[Sequence[Optional[float]]] = None,
+    pareto_rank: Optional[Sequence[Optional[int]]] = None,
+    costs: Optional[Sequence[Optional[float]]] = None,
 ) -> None:
     """Emit one per-rung promotion record (no-op with no sink attached).
 
     ``losses`` may contain None (crashed configs); ``scores`` is the
     promotion rule's ranking values when they differ from the raw losses
-    (H2BO extrapolation). The cut threshold is the worst promoted loss —
-    the rung's effective survival bar in hindsight analysis.
+    (H2BO extrapolation / learning-curve early stopping). The cut
+    threshold is the worst promoted loss — the rung's effective survival
+    bar in hindsight analysis. ``pareto_rank`` carries the domination
+    counts a multi-objective decision ranked by; ``costs`` the measured
+    per-candidate evaluation cost (seconds), which is what makes a
+    recorded journal Pareto-replayable after the fact. Config ids the
+    straggler rule flagged since the last decision join the record as
+    ``straggler_observed`` (see :func:`note_straggler`).
     """
     if not E.get_bus().active:
         return  # no sink: skip the per-candidate list builds
@@ -149,6 +288,17 @@ def emit_promotion_decision(
     }
     if scores is not None:
         fields["scores"] = list(scores)
+    if pareto_rank is not None:
+        fields["pareto_rank"] = [
+            None if r is None else int(r) for r in pareto_rank
+        ]
+    if costs is not None:
+        fields["costs"] = [
+            None if c is None else float(c) for c in costs
+        ]
+    flagged = drain_stragglers(config_ids, budget=budget)
+    if flagged:
+        fields["straggler_observed"] = [list(k) for k in flagged]
     E.emit(E.PROMOTION_DECISION, **fields)
 
 

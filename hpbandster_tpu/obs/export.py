@@ -72,8 +72,11 @@ _SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
 #: repo mints with an entity baked into the dotted name is re-expressed
 #: as one labeled family, the idiom scrapers can aggregate over.
 #: DOTALL: entity names (worker ids especially) may carry any byte — the
-#: label value escaping handles them, so the match must not stop at \n
-_LABEL_RULES: Tuple[Tuple[re.Pattern, str, str], ...] = (
+#: label value escaping handles them, so the match must not stop at \n.
+#: The third element is a label key (single-label rules: the group is
+#: named ``label``) or a tuple of keys (multi-label rules: groups named
+#: after the keys themselves).
+_LABEL_RULES: Tuple[Tuple[re.Pattern, str, object], ...] = (
     (re.compile(r"^runtime\.device\.(?P<label>\d+)\.(?P<field>[a-z_]+)$"),
      "runtime_device_{field}", "device"),
     # sharded-sweep balance gauges (parallel/multihost.py
@@ -93,6 +96,14 @@ _LABEL_RULES: Tuple[Tuple[re.Pattern, str, str], ...] = (
      "runtime_fn_bytes_accessed", "fn"),
     (re.compile(r"^anomaly\.alerts\.(?P<label>.+)$", re.DOTALL),
      "anomaly_rule_alerts", "rule"),
+    # promotion-rule counters (obs/audit.py emit_bracket_promotion):
+    # bracket.promotions.<rule>.<rung> ->
+    # bracket_promotions{rule="<rule>", rung="<rung>"}. The greedy rule
+    # group + the digits-only rung tail means a rule name containing
+    # dots keeps them in the label (the LAST dot separates the rung).
+    (re.compile(
+        r"^bracket\.promotions\.(?P<rule>.+)\.(?P<rung>\d+)$", re.DOTALL),
+     "bracket_promotions", ("rule", "rung")),
     (re.compile(
         r"^dispatcher\.worker_last_seen_age_s\.(?P<label>.+)$", re.DOTALL),
      "dispatcher_worker_last_seen_age_s", "worker"),
@@ -124,11 +135,20 @@ def metric_family(name: str, namespace: str = DEFAULT_NAMESPACE) -> Tuple[str, D
         m = pattern.match(name)
         if m is not None:
             groups = m.groupdict()
+            if isinstance(label_key, str):
+                labels = {label_key: groups["label"]}
+                label_groups = {"label"}
+            else:  # multi-label rule: groups are named after the keys
+                labels = {k: groups[k] for k in label_key}
+                label_groups = set(label_key)
             family = family_tmpl.format(
-                **{k: _sanitize(v) for k, v in groups.items() if k != "label"}
+                **{
+                    k: _sanitize(v)
+                    for k, v in groups.items() if k not in label_groups
+                }
             )
             prefix = f"{namespace}_" if namespace else ""
-            return prefix + _sanitize(family), {label_key: groups["label"]}
+            return prefix + _sanitize(family), labels
     prefix = f"{namespace}_" if namespace else ""
     return prefix + _sanitize(name), {}
 

@@ -46,7 +46,12 @@ from hpbandster_tpu.obs.audit import config_key, config_lineage
 from hpbandster_tpu.obs.runtime import compile_stats_from_records
 from hpbandster_tpu.obs.trace import DEFAULT_TENANT
 
-__all__ = ["build_report", "format_report", "filter_tenant"]
+__all__ = [
+    "build_report",
+    "format_report",
+    "filter_tenant",
+    "promotion_hindsight",
+]
 
 
 def filter_tenant(
@@ -88,6 +93,68 @@ def _finite(v: Any) -> Optional[float]:
     ):
         return float(v)
     return None
+
+
+def promotion_hindsight(
+    config_ids: List[Any],
+    scores: List[Optional[float]],
+    mask: List[bool],
+    next_budget: Any,
+    lineages: Dict[Tuple[int, ...], Dict[str, Any]],
+) -> Dict[str, Any]:
+    """Judge one promotion (ranking ``scores``, promotion ``mask``)
+    against next-budget results: rank-1 (incumbent) regret and pairwise
+    rank inversions among the promoted configs that were actually
+    evaluated further. THE one implementation — the report's
+    promotion-regret table and the replay harness
+    (``promote/replay.py``) both call it, so the two views of a journal
+    cannot drift.
+
+    Ties in ``scores`` break by candidate order — this is load-bearing:
+    Pareto's integer domination counts tie across a whole front, and
+    breaking by the next loss would hand every tied group a free zero
+    regret and hide within-tie inversions. Callers resolve their own
+    score fallbacks (e.g. raw losses) before passing.
+    """
+    from hpbandster_tpu.obs.audit import config_key
+
+    # (rank value, candidate index, next loss)
+    pairs: List[Tuple[float, int, float]] = []
+    if isinstance(next_budget, (int, float)):
+        for idx, (cid, score, promoted) in enumerate(
+            zip(config_ids, scores, mask)
+        ):
+            if not promoted:
+                continue
+            rank_value = _finite(score)
+            key = config_key(cid)
+            nxt = (
+                _finite(
+                    (lineages.get(key) or {})
+                    .get("results", {})
+                    .get(float(next_budget))
+                )
+                if key else None
+            )
+            if rank_value is not None and nxt is not None:
+                pairs.append((rank_value, idx, nxt))
+    rank1_regret = None
+    inversions = None
+    if pairs:
+        ordered = sorted(pairs)
+        best_next = min(p[2] for p in pairs)
+        rank1_regret = round(ordered[0][2] - best_next, 6)
+        inv = 0
+        for i in range(len(ordered)):
+            for j in range(i + 1, len(ordered)):
+                if ordered[i][2] > ordered[j][2]:
+                    inv += 1
+        inversions = inv
+    return {
+        "evaluated_promoted": len(pairs),
+        "rank1_regret": rank1_regret,
+        "inversions": inversions,
+    }
 
 
 # ----------------------------------------------------------------- sections
@@ -202,35 +269,19 @@ def _promotion_regret(
         ranks = scores if isinstance(scores, list) and len(scores) == len(losses) else losses
         # promoted configs with a result at the next budget: the only
         # hindsight available (terminated configs were never evaluated
-        # further — regret is measured within the promoted set)
-        pairs: List[Tuple[float, float]] = []  # (rank value, next loss)
-        if isinstance(next_budget, (int, float)):
-            for cid, loss, rank, prom in zip(ids, losses, ranks, promoted):
-                if not prom:
-                    continue
-                rank_value = _finite(rank)
-                if rank_value is None:
-                    rank_value = _finite(loss)
-                key = config_key(cid)
-                nxt = (
-                    _finite((lineages.get(key) or {}).get("results", {})
-                            .get(float(next_budget)))
-                    if key else None
-                )
-                if rank_value is not None and nxt is not None:
-                    pairs.append((rank_value, nxt))
-        rank1_regret = None
-        inversions = None
-        if pairs:
-            ordered = sorted(pairs)  # by rank value (stable tiebreak on next)
-            best_next = min(p[1] for p in pairs)
-            rank1_regret = round(ordered[0][1] - best_next, 6)
-            inv = 0
-            for i in range(len(ordered)):
-                for j in range(i + 1, len(ordered)):
-                    if ordered[i][1] > ordered[j][1]:
-                        inv += 1
-            inversions = inv
+        # further — regret is measured within the promoted set). Score
+        # fallback: where the rule recorded no score, its ranking value
+        # was the raw rung loss.
+        resolved = [
+            _finite(rank) if _finite(rank) is not None else _finite(loss)
+            for rank, loss in zip(ranks, losses)
+        ]
+        hindsight = promotion_hindsight(
+            list(ids), resolved, [bool(p) for p in promoted],
+            next_budget, lineages,
+        )
+        rank1_regret = hindsight["rank1_regret"]
+        inversions = hindsight["inversions"]
         rows.append({
             "iteration": rec.get("iteration"),
             "rung": rec.get("rung"),
@@ -240,12 +291,19 @@ def _promotion_regret(
             "n_candidates": rec.get("n_candidates"),
             "n_promoted": rec.get("n_promoted"),
             "cut_threshold": rec.get("cut_threshold"),
-            "evaluated_promoted": len(pairs),
+            "evaluated_promoted": hindsight["evaluated_promoted"],
             "rank1_regret": rank1_regret,
             "rank_held": (
                 rank1_regret <= 0.0 if rank1_regret is not None else None
             ),
             "inversions": inversions,
+            # anomaly correlation (obs/audit.py straggler ledger): how
+            # many of this rung's candidates the straggler rule flagged
+            # before the decision — high regret WITH stalls reads very
+            # differently from high regret on a healthy rung
+            "stragglers_observed": len(
+                rec.get("straggler_observed") or []
+            ),
         })
     rows.sort(key=lambda r: (r["iteration"] or 0, r["rung"] or 0))
 
@@ -458,7 +516,7 @@ def format_report(rep: Dict[str, Any]) -> str:
         lines.append(
             f"  {'iter':>5} {'rung':>5} {'budget':>8} {'next':>8} "
             f"{'cand':>5} {'prom':>5} {'cut':>12} {'regret':>10} "
-            f"{'held':>5} {'inv':>4}  rule"
+            f"{'held':>5} {'inv':>4} {'strag':>5}  rule"
         )
         for d in decisions:
             lines.append(
@@ -466,7 +524,8 @@ def format_report(rep: Dict[str, Any]) -> str:
                 f"{_fmt(d['budget']):>8} {_fmt(d['next_budget']):>8} "
                 f"{_fmt(d['n_candidates']):>5} {_fmt(d['n_promoted']):>5} "
                 f"{_fmt(d['cut_threshold']):>12} {_fmt(d['rank1_regret']):>10} "
-                f"{_fmt(d['rank_held']):>5} {_fmt(d['inversions']):>4}  "
+                f"{_fmt(d['rank_held']):>5} {_fmt(d['inversions']):>4} "
+                f"{_fmt(d['stragglers_observed']):>5}  "
                 f"{d['rule'] or '?'}"
             )
         for rung, agg in rep["promotion_regret"]["per_rung"].items():

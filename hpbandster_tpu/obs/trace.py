@@ -56,6 +56,8 @@ __all__ = [
     "use_trace",
     "current_tenant",
     "use_tenant",
+    "current_run",
+    "use_run",
     "current_wire",
     "extract_wire",
     "extract_tenant",
@@ -141,6 +143,44 @@ def use_tenant(tenant: Optional[str]) -> Iterator[Optional[str]]:
         yield tenant
     finally:
         _TENANT.reset(token)
+
+
+# -------------------------------------------------------------------- run
+#: the run (sweep) active in this thread/context. Unlike the trace (one
+#: per JOB) this is one per MASTER drive loop: process-global state that
+#: must not bleed between sequential or concurrent sweeps in one process
+#: (the promotion-audit straggler ledger, obs/audit.py) keys on it. Not
+#: stamped onto events — journal records already carry run identity
+#: through their trace context where it matters.
+_RUN: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "hpbandster_tpu_obs_run", default=None
+)
+
+
+def current_run() -> Optional[str]:
+    """The run id active in this thread/context, or None."""
+    run = _RUN.get()
+    if run is not None:
+        return run
+    # inside a job's trace the run identity is already known — the
+    # fallback that lets bus sinks (anomaly detector) attribute without
+    # their emitter having entered use_run explicitly
+    ctx = _CURRENT.get()
+    return ctx.run_id if ctx is not None and ctx.run_id else None
+
+
+@contextlib.contextmanager
+def use_run(run_id: Optional[str]) -> Iterator[Optional[str]]:
+    """Run the body under a run (sweep) identity. ``use_run(None)`` is a
+    no-op passthrough like :func:`use_trace` / :func:`use_tenant`."""
+    if run_id is None:
+        yield None
+        return
+    token = _RUN.set(str(run_id))
+    try:
+        yield run_id
+    finally:
+        _RUN.reset(token)
 
 
 # ------------------------------------------------------------------- wire

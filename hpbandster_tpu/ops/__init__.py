@@ -6,7 +6,12 @@ from hpbandster_tpu.ops.bracket import (  # noqa: F401
     hyperband_bracket,
     hyperband_schedule,
     max_sh_iterations,
+    pareto_promotion_mask,
+    pareto_promotion_mask_np,
+    pareto_rank,
+    pareto_rank_np,
     sh_promotion_mask,
+    sh_promotion_mask_np,
     sh_resample_mask,
 )
 from hpbandster_tpu.ops.buckets import (  # noqa: F401

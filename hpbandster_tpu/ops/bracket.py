@@ -28,6 +28,10 @@ __all__ = [
     "sh_promotion_mask_compiled",
     "sh_promotion_mask_np",
     "sh_resample_mask",
+    "pareto_rank",
+    "pareto_rank_np",
+    "pareto_promotion_mask",
+    "pareto_promotion_mask_np",
     "power_law_extrapolate",
 ]
 
@@ -197,6 +201,94 @@ def sh_promotion_mask_np(losses: np.ndarray, k) -> np.ndarray:
     clean = np.where(np.isnan(losses), np.float32(np.inf), losses)
     ranks = np.argsort(np.argsort(clean, kind="stable"), kind="stable")
     return ranks < k
+
+
+def pareto_rank(objectives: jax.Array) -> jax.Array:
+    """Domination-count Pareto ranking, jittable: ``objectives f32[n, m]``
+    (all minimized) -> ``i32[n]`` where rank 0 is the Pareto front.
+
+    ``rank[j]`` counts the rows that dominate row ``j`` (all objectives
+    <= and at least one <). A NaN in column 0 (the loss: a CRASHED
+    config) invalidates its whole row — every entry becomes +inf, so a
+    crashed config that happened to fail cheaply cannot ride its low
+    measured cost onto the front and displace a healthy config from a
+    promotion slot. A NaN in a later column alone (an unmeasured cost)
+    only infs that entry: the row stays rankable by its finite loss.
+    O(n^2 m) pairwise compare: the rung widths this ranks are
+    bracket-sized (dozens to low thousands), far under the sort-based
+    kernels' scale.
+    """
+    obj = jnp.asarray(objectives, jnp.float32)
+    crashed = jnp.isnan(obj[:, 0])
+    clean = jnp.where(
+        jnp.isnan(obj) | crashed[:, None], jnp.inf, obj
+    )
+    # dominates[i, j]: row i dominates row j
+    le = (clean[:, None, :] <= clean[None, :, :]).all(axis=-1)
+    lt = (clean[:, None, :] < clean[None, :, :]).any(axis=-1)
+    return (le & lt).sum(axis=0).astype(jnp.int32)
+
+
+def pareto_promotion_mask(objectives: jax.Array, k) -> jax.Array:
+    """Pareto-front top-``k`` promotion as a pure jittable kernel.
+
+    ``objectives`` is ``f32[n, m]`` with column 0 the rung loss (NaN =
+    crashed) and the remaining columns measured costs (NaN = unmeasured,
+    treated as +inf). Selection order is (domination count, loss rank,
+    row index) — Pareto fronts peel first, ties inside a front resolve
+    by the loss column under the same f32 double-argsort ranking as
+    :func:`sh_promotion_mask`, so the single-objective case degrades to
+    exactly the successive-halving rule. Crashed rows (NaN loss) are
+    NEVER promoted, whatever ``k`` — the same crash-safety contract as
+    ``sh_promotion_mask``'s NaN -> +inf — and, because
+    :func:`pareto_rank` infs a crashed row WHOLESALE, a config that
+    crashed cheaply cannot occupy a front slot and displace a healthy
+    config out of the top-k either.
+    """
+    obj = jnp.asarray(objectives, jnp.float32)
+    loss = obj[:, 0]
+    ranks = pareto_rank(obj)
+    clean_loss = jnp.where(jnp.isnan(loss), jnp.inf, loss)
+    loss_order = jnp.argsort(jnp.argsort(clean_loss))
+    # lexicographic (pareto rank, loss rank) via two stable sorts
+    # (secondary first, then primary over the permuted rows) — a
+    # composite integer key `ranks * n + order` would overflow i32 near
+    # n = 46341, and i64 is unavailable with x64 disabled
+    by_loss = jnp.argsort(loss_order)
+    final_perm = by_loss[jnp.argsort(ranks[by_loss])]
+    positions = jnp.argsort(final_perm)
+    return (positions < k) & ~jnp.isnan(loss)
+
+
+def pareto_rank_np(objectives: np.ndarray) -> np.ndarray:
+    """Host (numpy) twin of :func:`pareto_rank` — identical f32
+    semantics, for the Master's host-side bracket bookkeeping."""
+    obj = np.asarray(objectives, dtype=np.float32)
+    crashed = np.isnan(obj[:, 0])
+    clean = np.where(
+        np.isnan(obj) | crashed[:, None], np.float32(np.inf), obj
+    )
+    le = (clean[:, None, :] <= clean[None, :, :]).all(axis=-1)
+    lt = (clean[:, None, :] < clean[None, :, :]).any(axis=-1)
+    return (le & lt).sum(axis=0).astype(np.int32)
+
+
+def pareto_promotion_mask_np(objectives: np.ndarray, k) -> np.ndarray:
+    """Host twin of :func:`pareto_promotion_mask` (stable argsorts, f32
+    comparisons) — bit-identical masks to the device kernel."""
+    obj = np.asarray(objectives, dtype=np.float32)
+    loss = obj[:, 0]
+    ranks = pareto_rank_np(obj)
+    clean_loss = np.where(np.isnan(loss), np.float32(np.inf), loss)
+    loss_order = np.argsort(
+        np.argsort(clean_loss, kind="stable"), kind="stable"
+    )
+    # same two-stable-sort lexicographic selection as the device kernel
+    # (overflow-free at any n, identical tie resolution)
+    by_loss = np.argsort(loss_order, kind="stable")
+    final_perm = by_loss[np.argsort(ranks[by_loss], kind="stable")]
+    positions = np.argsort(final_perm, kind="stable")
+    return (positions < k) & ~np.isnan(loss)
 
 
 def power_law_extrapolate(

@@ -35,6 +35,7 @@ class BOHB(Master):
         min_bandwidth: float = 1e-3,
         seed: Optional[int] = None,
         iteration_class: type = SuccessiveHalving,
+        promotion_rule: Optional[str] = None,
         in_trace_refit: Optional[bool] = None,
         **kwargs: Any,
     ):
@@ -51,7 +52,21 @@ class BOHB(Master):
             seed=seed,
             in_trace_refit=in_trace_refit,
         )
+        # the promotion-rule seam (hpbandster_tpu/promote,
+        # docs/promotion.md): a rule name resolves to its iteration class
+        # — how a sweep opts into async (asha), multi-objective (pareto),
+        # or learning-curve early-stop promotion without touching the
+        # bracket arithmetic. An explicit iteration_class still wins
+        # when no rule name is given (back-compat). Resolved BEFORE
+        # Master.__init__: that call starts the executor, and a typo'd
+        # rule name raising afterwards would leak its running
+        # dispatcher threads with no handle to shut them down.
+        if promotion_rule is not None:
+            from hpbandster_tpu.promote import resolve_rule
+
+            iteration_class = resolve_rule(promotion_rule)
         super().__init__(config_generator=cg, **kwargs)
+        self.promotion_rule = promotion_rule
         self.iteration_class = iteration_class
 
         self.configspace = configspace
@@ -74,6 +89,10 @@ class BOHB(Master):
                 "random_fraction": random_fraction,
                 "bandwidth_factor": bandwidth_factor,
                 "min_bandwidth": min_bandwidth,
+                "promotion_rule": (
+                    promotion_rule
+                    or getattr(iteration_class, "promotion_rule", None)
+                ),
             }
         )
 
@@ -94,10 +113,19 @@ class BOHB(Master):
             iteration, plan.num_configs, plan.budgets,
             eta=self.eta, random_fraction=self.config.get("random_fraction"),
         )
+        # rule-specific wiring the iteration classes opt into by class
+        # attribute: asha wants the ladder's eta, learning-curve early
+        # stopping wants a sweep-wide incumbent reader for its cut
+        extra: Dict[str, Any] = {}
+        if getattr(self.iteration_class, "wants_eta", False):
+            extra["eta"] = self.eta
+        if getattr(self.iteration_class, "wants_cut_fn", False):
+            extra["cut_fn"] = self.best_loss_at
         return self.iteration_class(
             HPB_iter=iteration,
             num_configs=list(plan.num_configs),
             budgets=list(plan.budgets),
             config_sampler=self.config_generator.get_config,
+            **extra,
             **iteration_kwargs,
         )

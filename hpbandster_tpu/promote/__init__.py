@@ -1,0 +1,88 @@
+"""``hpbandster_tpu.promote`` — the promotion-rule subsystem.
+
+Decouples "when does a config advance" from the bracket loop. The paper's
+synchronous successive-halving barrier (``core/successive_halving.py``)
+is one rule among several behind one interface: an iteration class the
+optimizer instantiates per bracket, selectable by name —
+``BOHB(promotion_rule="asha")`` per sweep, ``SweepSpec(promotion_rule=
+"asha")`` per tenant through the serving tier.
+
+Rules shipped (see docs/promotion.md for the semantics and math):
+
+* ``successive_halving`` / ``sync`` — the paper's barrier rule: wait for
+  the full rung, promote the top ``num_configs[stage+1]`` by loss
+  (``sync`` is an alias; ``successive_halving_jax`` decides the mask
+  on-device).
+* ``asha`` — asynchronous successive halving
+  (:class:`~hpbandster_tpu.promote.asha.ASHAIteration`): a config is
+  promoted the moment it enters the top ``1/eta`` of its rung's
+  COMPLETED results — no barrier, so one straggler stalls only itself
+  while sibling promotions dispatch at higher budgets. Sound because
+  HyperBand's analysis only needs comparable losses *within* a rung
+  (PAPERS.md), and safe out of order because result ingestion is
+  exactly-once (core/recovery.py).
+* ``pareto`` — multi-objective promotion
+  (:class:`~hpbandster_tpu.promote.pareto.ParetoIteration`): rungs rank
+  on (loss, measured evaluation cost) via the Pareto-front top-k kernel
+  in ``ops/bracket.py`` — domination-count fronts peel first, loss
+  breaks ties inside a front, crashed-NaN rows never promote.
+* ``lc_earlystop`` — learning-curve early stopping
+  (:class:`~hpbandster_tpu.promote.earlystop.LCEarlyStopIteration`):
+  the ``models/learning_curves.py`` power-law extrapolation terminates
+  configs whose predicted final-budget loss cannot reach the current
+  cut, even when their rung rank would have promoted them.
+
+Every rule emits the same ``promotion_decision`` audit records (with its
+own ``rule`` name), so existing report tooling keeps working, and
+:mod:`~hpbandster_tpu.promote.replay` re-scores any recorded journal
+under any rule — rank-inversion and incumbent-regret deltas,
+byte-identical across invocations.
+
+This module is import-light by design (no jax, no numpy): the serving
+tier validates rule names against :data:`RULE_NAMES` without paying for
+the implementations; :func:`resolve_rule` imports lazily.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+__all__ = ["RULE_NAMES", "resolve_rule"]
+
+#: rule name -> (module, class). Lazy: resolving imports the module.
+_RULES: Dict[str, Tuple[str, str]] = {
+    "successive_halving": (
+        "hpbandster_tpu.core.successive_halving", "SuccessiveHalving"
+    ),
+    "sync": (
+        "hpbandster_tpu.core.successive_halving", "SuccessiveHalving"
+    ),
+    "successive_halving_jax": (
+        "hpbandster_tpu.core.successive_halving", "JaxSuccessiveHalving"
+    ),
+    "asha": ("hpbandster_tpu.promote.asha", "ASHAIteration"),
+    "pareto": ("hpbandster_tpu.promote.pareto", "ParetoIteration"),
+    "lc_earlystop": (
+        "hpbandster_tpu.promote.earlystop", "LCEarlyStopIteration"
+    ),
+}
+
+#: the selectable vocabulary (SweepSpec validation, CLI help)
+RULE_NAMES: Tuple[str, ...] = tuple(sorted(_RULES))
+
+
+def resolve_rule(name: str) -> type:
+    """Promotion-rule name -> iteration class (lazy import).
+
+    Raises ``ValueError`` with the known vocabulary on an unknown name —
+    the serving tier surfaces it verbatim as the admission reject reason.
+    """
+    try:
+        module_name, attr = _RULES[str(name)]
+    except KeyError:
+        raise ValueError(
+            f"unknown promotion rule {name!r} (supported: {RULE_NAMES})"
+        ) from None
+    import importlib
+
+    return getattr(importlib.import_module(module_name), attr)

@@ -59,6 +59,7 @@ class SweepSpec:
         random_fraction: float = 1 / 3,
         seed: Optional[int] = None,
         warm_start: bool = True,
+        promotion_rule: Optional[str] = None,
     ):
         if optimizer not in OPTIMIZERS:
             raise ValueError(
@@ -70,6 +71,24 @@ class SweepSpec:
             raise ValueError("need 0 < min_budget <= max_budget")
         if float(eta) <= 1:
             raise ValueError("eta must be > 1")
+        if promotion_rule is not None:
+            # promote/__init__ is import-light by contract (no jax /
+            # numpy), so eager name validation stays cheap and rejects
+            # carry the full vocabulary as their reason
+            from hpbandster_tpu.promote import RULE_NAMES
+
+            if promotion_rule not in RULE_NAMES:
+                raise ValueError(
+                    f"unknown promotion rule {promotion_rule!r} "
+                    f"(supported: {RULE_NAMES})"
+                )
+            if optimizer != "bohb":
+                raise ValueError(
+                    "promotion_rule applies to the 'bohb' optimizer "
+                    "(random search runs single-stage brackets: there "
+                    "is nothing to promote)"
+                )
+        self.promotion_rule = promotion_rule
         self.optimizer = optimizer
         self.n_iterations = int(n_iterations)
         self.eta = float(eta)
@@ -88,6 +107,7 @@ class SweepSpec:
         known = {
             "optimizer", "n_iterations", "eta", "min_budget", "max_budget",
             "num_samples", "random_fraction", "seed", "warm_start",
+            "promotion_rule",
         }
         unknown = set(d) - known
         if unknown:
@@ -105,6 +125,7 @@ class SweepSpec:
             "random_fraction": self.random_fraction,
             "seed": self.seed,
             "warm_start": self.warm_start,
+            "promotion_rule": self.promotion_rule,
         }
 
     def estimated_cost(self) -> float:
@@ -404,6 +425,7 @@ class TenantMaster:
                     num_samples=spec.num_samples,
                     random_fraction=spec.random_fraction,
                     previous_result=previous,
+                    promotion_rule=spec.promotion_rule,
                     **common,
                 )
             else:

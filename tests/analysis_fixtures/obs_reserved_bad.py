@@ -34,3 +34,18 @@ def forged_tenant(cid):
     # tenant identity is stamped by use_tenant's context, never a kwarg:
     # a hand-written tenant_id mis-attributes another tenant's work
     obs.emit("job_finished", config_id=cid, tenant_id="acme")  # BAD
+
+
+def forged_promotion_audit(cids):
+    # promotion-audit fields belong to the dedicated emitters
+    # (emit_bracket_promotion / emit_promotion_decision): a generic emit
+    # inventing them corrupts the replay/regret join
+    obs.emit("bracket_promotion", promoted=1, rule="asha")  # BAD
+    emit("promotion_decision", config_ids=cids, rung=0)  # BAD
+    obs.emit("my_event", pareto_rank=[0, 1])  # BAD
+
+
+def forged_straggler(bus):
+    bus.emit("promotion_decision", straggler_observed=[[0, 0, 1]])  # BAD
+    with span("compute", rule="pareto"):  # BAD
+        pass

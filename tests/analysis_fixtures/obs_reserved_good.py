@@ -37,6 +37,22 @@ def audited_sample(cid, info):
     obs.emit("config_sampled", config_id=cid, budget=1.0, lg_score=2.5)
 
 
+def audited_promotion(cids, losses, mask):
+    # promotion-audit fields enter records through the dedicated
+    # emitters — the sanctioned channel for exactly these names
+    obs.emit_bracket_promotion(
+        0, 0, "asha", promoted=2, candidates=9,
+        budget=1.0, next_budget=3.0,
+    )
+    obs.emit_promotion_decision(
+        0, 0, 1.0, 3.0, config_ids=cids, losses=losses, promoted=mask,
+        rule="asha", pareto_rank=[0, 1], costs=[0.5, 0.7],
+    )
+    # ordinary fields that merely RESEMBLE the audit vocabulary stay
+    # unflagged on generic emitters
+    obs.emit("kde_refit", rule_version=2, rungs_total=3)
+
+
 def configured_identity(path):
     # host/pid enter records via static fields, once, at configure time
     journal = JsonlJournal(path, static_fields=process_identity(worker_id="w0"))

@@ -23,6 +23,7 @@ CASES = [
     ("swallowed-exception", "exceptions_bad.py", "exceptions_good.py"),
     ("pytest-marker", "test_markers_bad.py", "test_markers_good.py"),
     ("obs-emit-in-jit", "obs_emit_bad.py", "obs_emit_good.py"),
+    ("obs-reserved-fields", "obs_reserved_bad.py", "obs_reserved_good.py"),
     ("jit-in-loop", "jit_loop_bad.py", "jit_loop_good.py"),
     ("jit-donation", "donation_bad.py", "donation_good.py"),
     ("wallclock-duration", "wallclock_bad.py", "wallclock_good.py"),

@@ -502,6 +502,13 @@ def _stub_tiers(monkeypatch, calls):
         and {"n_workers": 4, "median": 50.0, "iqr": [45.0, 55.0],
              "throughput_retention": 0.8, "trajectory_consistent": True,
              "recovery": {"requeues": 3}})
+    monkeypatch.setattr(
+        bench, "bench_async_straggler",
+        lambda **kw: calls.setdefault("async_straggler", True)
+        and {"n_workers": 3, "median": 60.0, "iqr": [55.0, 65.0],
+             "throughput_ratio": 1.4,
+             "barrier_stall_s": {"sync_median": 0.35, "asha_median": 0.0},
+             "utilization_delta": 0.2, "straggler_markers": 2})
 
 
 class TestFallbackContract:
@@ -678,8 +685,8 @@ class TestTierSelection:
             "cnn", "cnn_wide", "pallas", "resnet", "transformer",
             "fused_1M", "fused_100k", "fused10k", "chunked10k",
             "chunked_compile", "fused", "rpc", "batched", "teacher",
-            "multitenant", "chaos", "obs_overhead", "runtime_overhead",
-            "collector_overhead", "report_100k",
+            "multitenant", "chaos", "async_straggler", "obs_overhead",
+            "runtime_overhead", "collector_overhead", "report_100k",
         }
 
 

@@ -1,0 +1,98 @@
+"""Degenerate-input coverage for models/learning_curves.py.
+
+The extrapolation path gained a promotion-rule caller in this PR
+(promote/earlystop.py feeds it curves straight from crash-NaN-masked
+bracket state), so the edge cases are pinned explicitly: single
+observations, all-NaN curves, non-finite points mid-curve, non-monotone
+and duplicate budgets — none may crash, and each falls back along the
+documented ladder (clean -> power-law fit -> last value -> NaN).
+"""
+
+import numpy as np
+import pytest
+
+from hpbandster_tpu.models.learning_curves import (
+    LastValueModel,
+    PowerLawModel,
+    clean_curve,
+)
+
+
+class TestCleanCurve:
+    def test_drops_non_finite_points_and_sorts(self):
+        curve = [
+            (9.0, 0.2), (1.0, np.nan), (3.0, 0.5),
+            (np.inf, 0.1), (1.0, 0.9), (27.0, -np.inf),
+        ]
+        assert clean_curve(curve) == [(1.0, 0.9), (3.0, 0.5), (9.0, 0.2)]
+
+    def test_duplicate_budgets_keep_relative_order(self):
+        # stable sort on budget only: the later record of a re-evaluated
+        # rung stays the later point
+        assert clean_curve([(3.0, 0.5), (1.0, 0.9), (3.0, 0.4)]) == [
+            (1.0, 0.9), (3.0, 0.5), (3.0, 0.4),
+        ]
+
+
+class TestDegenerateInputs:
+    @pytest.mark.parametrize("model", [LastValueModel(), PowerLawModel()])
+    def test_single_observation_predicts_it(self, model):
+        assert model.predict([(3.0, 0.7)], 81.0) == 0.7
+
+    @pytest.mark.parametrize("model", [LastValueModel(), PowerLawModel()])
+    def test_empty_and_all_nan_curves_predict_nan(self, model):
+        assert np.isnan(model.predict([], 81.0))
+        all_nan = [(1.0, np.nan), (3.0, np.nan), (9.0, np.nan)]
+        assert np.isnan(model.predict(all_nan, 81.0))
+
+    def test_nan_points_mid_curve_are_dropped_not_poisonous(self):
+        # the two finite points survive; < 3 points -> last-value
+        curve = [(1.0, 0.9), (3.0, np.nan), (9.0, 0.5)]
+        assert PowerLawModel().predict(curve, 81.0) == 0.5
+
+    def test_non_monotone_budget_order_is_sorted_first(self):
+        decaying = [(b, 1.0 * b ** -0.5 + 0.1) for b in (1, 3, 9, 27)]
+        shuffled = [decaying[2], decaying[0], decaying[3], decaying[1]]
+        a = PowerLawModel().predict(decaying, 81.0)
+        b = PowerLawModel().predict(shuffled, 81.0)
+        assert a == b
+        assert a == pytest.approx(1.0 * 81 ** -0.5 + 0.1, rel=0.05)
+
+    def test_rising_curve_falls_back_to_last_value(self):
+        rising = [(1.0, 0.1), (3.0, 0.2), (9.0, 0.3)]
+        assert PowerLawModel().predict(rising, 27.0) == 0.3
+
+    def test_constant_curve_does_not_crash(self):
+        flat = [(1.0, 0.5), (3.0, 0.5), (9.0, 0.5)]
+        pred = PowerLawModel().predict(flat, 81.0)
+        assert np.isfinite(pred)
+        # a flat curve extrapolates to (about) its own level
+        assert pred == pytest.approx(0.5, abs=0.05)
+
+    def test_inf_budget_point_dropped(self):
+        curve = [(1.0, 0.9), (np.inf, 0.0), (3.0, 0.5), (9.0, 0.3)]
+        pred = PowerLawModel().predict(curve, 81.0)
+        assert np.isfinite(pred)
+        assert pred <= 0.5  # fitted on the three finite points
+
+
+class TestDeviceTwinDegenerates:
+    def test_all_nan_rows_fall_back_to_last_column(self):
+        from hpbandster_tpu.ops.bracket import power_law_extrapolate
+
+        budgets = np.array([1.0, 3.0, 9.0], np.float32)
+        losses = np.array(
+            [[np.nan, np.nan, np.nan], [0.9, 0.5, 0.3]], np.float32
+        )
+        out = np.asarray(power_law_extrapolate(budgets, losses, 27.0))
+        # row 0: no information -> the (NaN) last value, never a crash
+        assert np.isnan(out[0])
+        assert np.isfinite(out[1]) and out[1] <= 0.3 + 1e-6
+
+    def test_single_column_returns_last_value(self):
+        from hpbandster_tpu.ops.bracket import power_law_extrapolate
+
+        budgets = np.array([1.0], np.float32)
+        losses = np.array([[0.4], [0.8]], np.float32)
+        out = np.asarray(power_law_extrapolate(budgets, losses, 27.0))
+        assert out.tolist() == [pytest.approx(0.4), pytest.approx(0.8)]

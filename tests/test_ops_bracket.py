@@ -8,6 +8,10 @@ from hpbandster_tpu.ops import (
     hyperband_bracket,
     hyperband_schedule,
     max_sh_iterations,
+    pareto_promotion_mask,
+    pareto_promotion_mask_np,
+    pareto_rank,
+    pareto_rank_np,
     sh_promotion_mask,
     sh_resample_mask,
 )
@@ -92,3 +96,91 @@ class TestPromotion:
         # ceil(2 * 0.5) = 1 promoted, 1 resampled
         assert np.asarray(mask).sum() == 1 and int(n_res) == 1
         assert bool(np.asarray(mask)[1])
+
+
+class TestParetoKernels:
+    """The multi-objective promotion kernel (docs/promotion.md):
+    domination-count ranking, loss tiebreak, crash-NaN hard exclusion,
+    and jit/non-jit parity — the promote/pareto.py contract."""
+
+    def test_dominance_on_hand_built_front(self):
+        # rows: (loss, cost). a=(.1,.9) and b=(.9,.1) trade off (front);
+        # c=(.2,.95) dominated by a only; d=(1.,1.) dominated by all
+        obj = np.array(
+            [[0.1, 0.9], [0.9, 0.1], [0.2, 0.95], [1.0, 1.0]],
+            dtype=np.float32,
+        )
+        ranks = pareto_rank_np(obj)
+        assert ranks.tolist() == [0, 0, 1, 3]
+
+    def test_topk_peels_fronts_then_loss(self):
+        obj = np.array(
+            [[0.1, 0.9], [0.9, 0.1], [0.2, 0.95], [1.0, 1.0]],
+            dtype=np.float32,
+        )
+        # k=2: the two front members, whatever their losses
+        assert pareto_promotion_mask_np(obj, 2).tolist() == [
+            True, True, False, False,
+        ]
+        # k=3: next front member joins (c, rank 1 beats d's rank 3)
+        assert pareto_promotion_mask_np(obj, 3).tolist() == [
+            True, True, True, False,
+        ]
+        # k=1: ties inside the front resolve by the loss column -> a
+        assert pareto_promotion_mask_np(obj, 1).tolist() == [
+            True, False, False, False,
+        ]
+
+    def test_single_objective_degrades_to_sh_rule(self, rng):
+        losses = rng.normal(size=17).astype(np.float32)
+        losses[3] = np.nan
+        from hpbandster_tpu.ops import sh_promotion_mask_np
+
+        sh = sh_promotion_mask_np(losses, 5)
+        pareto = pareto_promotion_mask_np(losses[:, None], 5)
+        assert pareto.tolist() == sh.tolist()
+
+    def test_cheap_crash_cannot_displace_healthy_from_topk(self):
+        # a config that crashed QUICKLY has NaN loss but a small
+        # measured cost — it must not occupy a front slot and shrink
+        # the healthy promotion set (the whole row is inf'd, not just
+        # the loss column)
+        obj = np.array(
+            [[np.nan, 0.1], [0.2, 0.5], [0.3, 0.6]], dtype=np.float32
+        )
+        assert pareto_rank_np(obj).tolist() == [2, 0, 1]
+        assert pareto_promotion_mask_np(obj, 2).tolist() == [
+            False, True, True,
+        ]
+        dev = np.asarray(pareto_promotion_mask(obj, 2))
+        assert dev.tolist() == [False, True, True]
+
+    def test_crashed_nan_rows_never_promoted(self):
+        obj = np.array(
+            [[np.nan, 0.1], [0.5, np.nan], [np.nan, np.nan]],
+            dtype=np.float32,
+        )
+        # even k = n promotes only the finite-loss row; a NaN cost is
+        # +inf (never an advantage) but not a death sentence
+        mask = pareto_promotion_mask_np(obj, 3)
+        assert mask.tolist() == [False, True, False]
+        # all-crashed rung: nothing promotes at any k
+        all_nan = np.full((4, 2), np.nan, dtype=np.float32)
+        assert not pareto_promotion_mask_np(all_nan, 4).any()
+
+    def test_jit_nonjit_parity(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        obj = rng.normal(size=(23, 3)).astype(np.float32)
+        obj[rng.integers(0, 23, size=4), 0] = np.nan
+        obj[rng.integers(0, 23, size=4), 2] = np.nan
+        jitted = jax.jit(pareto_promotion_mask, static_argnums=())
+        for k in (0, 1, 5, 23):
+            host = pareto_promotion_mask_np(obj, k)
+            dev = np.asarray(jitted(jnp.asarray(obj), jnp.int32(k)))
+            eager = np.asarray(pareto_promotion_mask(obj, k))
+            assert dev.tolist() == host.tolist() == eager.tolist(), k
+        ranks_host = pareto_rank_np(obj)
+        ranks_dev = np.asarray(jax.jit(pareto_rank)(jnp.asarray(obj)))
+        assert ranks_dev.tolist() == ranks_host.tolist()
