@@ -64,7 +64,8 @@ HEADLINE_BRACKETS = 27
 #: measured on a TPU; the headline fused/rpc pair has (BENCH_r02.json)
 TIER_ORDER = (
     "cnn", "cnn_wide", "pallas", "resnet", "transformer", "fused_1M",
-    "fused_100k", "fused10k", "chunked10k", "chunked_compile", "fused",
+    "fused_100k", "resident_100k", "fused10k", "chunked10k",
+    "chunked_compile", "fused",
     "rpc", "batched", "teacher", "multitenant", "chaos",
     "async_straggler", "obs_overhead",
     "runtime_overhead", "collector_overhead", "report_100k",
@@ -391,6 +392,154 @@ def bench_fused_sharded(n_configs, repeats=3, max_budget=9, seed=0,
         "accelerator backend: bound asserted vs candidate-array size"
     )
     return out
+
+
+def measure_kde_fit_cost(sizes=(1 << 14, 1 << 17, 1 << 20), d=2,
+                         repeats=3, seed=0):
+    """Truncnorm-KDE fit (``ops.kde.fit_kde_pair_masked``) wall seconds
+    at growing observation counts — the "is the model fit the wall at 1M
+    observations?" probe (ISSUE 12 / ROADMAP). One shape-polymorphic jit,
+    compile excluded, ``block_until_ready`` timed, median of repeats.
+    Returns ``{str(n_obs): seconds}``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hpbandster_tpu.ops.kde import fit_kde_pair_masked
+
+    rng = np.random.default_rng(seed)
+    cards = jnp.zeros(d, jnp.int32)
+
+    @jax.jit
+    def fit(v, l, n, k):
+        return fit_kde_pair_masked(v, l, n, k, k, cards, 1e-3)
+
+    out = {}
+    for cap in sizes:
+        v = jnp.asarray(rng.random((cap, d)).astype(np.float32))
+        l = jnp.asarray(rng.random(cap).astype(np.float32))
+        k = jnp.int32(max(cap // 10, 3))
+        jax.block_until_ready(fit(v, l, jnp.int32(cap), k))  # compile
+        ts = []
+        for _ in range(int(repeats)):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fit(v, l, jnp.int32(cap), k))
+            ts.append(time.perf_counter() - t0)
+        out[str(int(cap))] = round(statistics.median(ts), 4)
+    return out
+
+
+def bench_resident_sharded(sizes=(1 << 13, 1 << 17), n_brackets=3,
+                           max_budget=9, seed=0, cpu_fallback=True,
+                           kde_fit_sizes=(1 << 14, 1 << 17, 1 << 20)):
+    """``resident_100k``: the resident (scan-fused) incumbent-only sweep
+    (``run_sharded_fused_sweep(resident=True)``) at growing config counts
+    on the visible mesh — the whole multi-bracket schedule is ONE device
+    dispatch whose host traffic is a 4-byte seed up and one incumbent
+    down.
+
+    The flat-d2h acceptance is a measured assertion, not prose: the
+    per-sweep ``d2h_bytes``/``h2d_bytes``/``host_syncs`` (note_transfer
+    deltas, published as the ``sweep_transfer_bytes`` gauges) must be
+    IDENTICAL across every config count — host-sync count per sweep
+    constant in config count. On an accelerator backend a 1M-config size
+    joins the ladder (``cpu_fallback=False``); the CPU gate measures the
+    same code path at 8k/128k.
+
+    Also carried: the truncnorm-KDE fit cost probe
+    (:func:`measure_kde_fit_cost`) up to 1M observations, judged against
+    this tier's own per-bracket execute seconds — ``fit_is_wall`` says
+    whether an in-trace KDE refit would dominate a bracket at the
+    largest size (the ``HPB_PALLAS_KDE_FIT`` lever's evidence).
+    """
+    import jax
+
+    from hpbandster_tpu.parallel.mesh import config_mesh
+    from hpbandster_tpu.parallel.multihost import run_sharded_fused_sweep
+    from hpbandster_tpu.workloads.toys import branin_from_vector, branin_space
+
+    cs = branin_space(seed=seed)
+    devices = jax.devices()
+    n_dev = len(devices)
+    mesh = config_mesh(devices)
+    sizes = tuple(int(s) for s in sizes)
+    if not cpu_fallback and (1 << 20) not in sizes:
+        sizes = sizes + (1 << 20,)
+
+    per_size = []
+    bills = set()
+    for n in sizes:
+        # warmup compiles the size's program; the timed run measures it
+        run_sharded_fused_sweep(
+            branin_from_vector, cs, n_configs=n, min_budget=1,
+            max_budget=max_budget, eta=3, mesh=mesh, seed=seed + 99,
+            n_brackets=n_brackets, resident=True,
+        )
+        r = run_sharded_fused_sweep(
+            branin_from_vector, cs, n_configs=n, min_budget=1,
+            max_budget=max_budget, eta=3, mesh=mesh, seed=seed,
+            n_brackets=n_brackets, resident=True,
+        )
+        bills.add((r["d2h_bytes"], r["h2d_bytes"], r["host_syncs"]))
+        per_size.append({
+            "n_configs": n,
+            "evaluations": r["evaluations"],
+            "execute_fetch_s": r["execute_fetch_s"],
+            "configs_per_s_per_chip": round(
+                r["evaluations"] / r["execute_fetch_s"] / n_dev, 2
+            ) if r["execute_fetch_s"] else None,
+            "dispatches": len(r["chunks"]),
+            "d2h_bytes": r["d2h_bytes"],
+            "h2d_bytes": r["h2d_bytes"],
+            "host_syncs": r["host_syncs"],
+            "incumbent_loss": r["incumbent"]["loss"],
+        })
+    flat = len(bills) == 1
+    if not flat:
+        # the tier's acceptance bar: a scaling host-link bill is a
+        # regression in the resident contract, and the artifact must
+        # say so loudly (the _run_tier wrapper records it as an error)
+        raise AssertionError(
+            "resident host-link bill is NOT flat in config count: %r"
+            % sorted(bills)
+        )
+    kde_fit = measure_kde_fit_cost(sizes=kde_fit_sizes)
+    biggest = per_size[-1]
+    per_bracket_s = (
+        biggest["execute_fetch_s"] / n_brackets if n_brackets else None
+    )
+    fit_1m_s = kde_fit.get(str(1 << 20))
+    fit_is_wall = (
+        bool(fit_1m_s > 0.5 * per_bracket_s)
+        if fit_1m_s is not None and per_bracket_s else None
+    )
+    return {
+        "n_devices": n_dev,
+        "n_brackets": n_brackets,
+        "per_size": per_size,
+        "d2h_flat": True,
+        "host_syncs_per_sweep": per_size[0]["host_syncs"],
+        "transfer_gauges": {
+            "sweep.transfer_bytes.d2h": per_size[0]["d2h_bytes"],
+            "sweep.transfer_bytes.h2d": per_size[0]["h2d_bytes"],
+            "sweep.host_syncs": per_size[0]["host_syncs"],
+        },
+        # the KDE-fit wall probe: seconds per fit by observation count,
+        # vs this tier's own per-bracket device seconds. fit_is_wall=True
+        # is the signal to flip HPB_PALLAS_KDE_FIT=1 (the Pallas moment
+        # kernel, ops/pallas_kde.py) and re-baseline on the next TPU
+        # window — on CPU the number is directional only.
+        "kde_fit_s": kde_fit,
+        "per_bracket_execute_s": (
+            round(per_bracket_s, 4) if per_bracket_s else None
+        ),
+        "fit_is_wall": fit_is_wall,
+        "kde_fit_note": (
+            "CPU-measured: directional; re-measure (and the Pallas fit "
+            "twin) on the next TPU window" if cpu_fallback else
+            "accelerator-measured"
+        ),
+    }
 
 
 def bench_batched(n_iterations=5, repeats=5, seed=0):
@@ -1824,6 +1973,13 @@ TIER_BUDGETS = {
     # run — megabytes of headroom, not gigabytes of candidates (measured
     # CPU 8-device mesh: 2 compiles, <0.01 MB for fused_100k).
     "fused_100k":      {"max_compiles": 4,  "max_transfer_mb": 8},
+    # resident tier: ONE scanned program per config-count size (2 sizes
+    # on CPU, 3 with the accelerator 1M rung) plus the KDE-fit probe's
+    # shape-polymorphic jit (one compile per observation-count shape).
+    # Transfers are the whole point: a 4-byte seed up + one incumbent
+    # down per sweep — the 8 MB ceiling is pure headroom for the warmup
+    # runs' bills
+    "resident_100k":   {"max_compiles": 10, "max_transfer_mb": 8},
     "fused_1M":        {"max_compiles": 4,  "max_transfer_mb": 16},
     "chunked_compile": {"max_compiles": 8,  "max_transfer_mb": 16},
     "chunked10k":      {"max_compiles": 20, "max_transfer_mb": 128},
@@ -2030,6 +2186,12 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
         fused_100k = emit("fused_100k", _run_tier(
             errors, "fused_100k", bench_fused_sharded, n_configs=4096,
             repeats=repeats))
+        # smoke rung of the resident tier: tiny sizes, same code path
+        # (scan-fused schedule, flat-d2h assertion, KDE-fit probe)
+        resident_100k = emit("resident_100k", _run_tier(
+            errors, "resident_100k", bench_resident_sharded,
+            sizes=(1024, 4096), kde_fit_sizes=(1 << 12, 1 << 14),
+            cpu_fallback=True))
         fused_1M = {"skipped": "--smoke: the 1M-config program is not a "
                                "smoke-size measurement"}
         rpc_rates = _run_tier(errors, "rpc", bench_rpc_baseline,
@@ -2129,6 +2291,16 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
                 errors, "fused_100k", bench_fused_sharded,
                 n_configs=1 << 17, repeats=repeats))
             if selected("fused_100k") else dict(NOT_SELECTED)
+        )
+        # resident tier measures on any backend like fused_100k (the
+        # sweep is one scanned program; the flat-d2h assertion is the
+        # point, and it holds wherever note_transfer counts); the 1M
+        # rung joins only off the fallback path
+        resident_100k = (
+            emit("resident_100k", _run_tier(
+                errors, "resident_100k", bench_resident_sharded,
+                cpu_fallback=bool(backend_error)))
+            if selected("resident_100k") else dict(NOT_SELECTED)
         )
         if not selected("fused10k"):
             fused10k = dict(NOT_SELECTED)
@@ -2354,6 +2526,7 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
             },
             "fused_1M_mesh_sharded": fused_1M,
             "fused_100k_mesh_sharded": fused_100k,
+            "resident_100k_scan_fused": resident_100k,
             "cnn_workload_budget_sgd_steps": cnn,
             "cnn_wide_mxu_saturation": cnn_wide,
             "resnet_workload_budget_sgd_steps": resnet,

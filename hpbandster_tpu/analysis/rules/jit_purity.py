@@ -52,6 +52,31 @@ _CASTS = {"float", "int", "bool", "complex"}
 _NUMPY_SINKS = {"asarray", "array", "copy", "ascontiguousarray"}
 _METHOD_SINKS = {"item", "tolist", "__array__"}
 
+#: lax control-flow combinators whose FUNCTION arguments run in-trace:
+#: a scan/while/fori body (the resident outer-loop idiom, ops/sweep.py)
+#: is traced exactly like a jit-decorated function — host syncs inside
+#: it raise at trace time or force a device round-trip per iteration,
+#: which inside a loop body is the worst place to pay one
+_LAX_BODY_WRAPPERS = {
+    "jax.lax.scan",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.cond",
+    "jax.lax.switch",
+    "jax.lax.map",
+    "lax.scan",
+    "lax.while_loop",
+    "lax.fori_loop",
+    "lax.cond",
+    "lax.switch",
+    "lax.map",
+}
+
+#: tracer attributes whose value is trace-time METADATA, not device data:
+#: ``float(x.shape[0])`` / ``float(len(x))`` are concrete at trace time
+#: and must not be flagged (the static-shape arithmetic idiomatic here)
+_STATIC_TRACER_ATTRS = {"shape", "ndim", "size", "dtype"}
+
 
 def _is_jit_expr(node: ast.AST, imports: ImportMap) -> bool:
     """True for ``jax.jit`` / ``partial(jax.jit, ...)`` / ``jax.jit(...)``
@@ -101,6 +126,23 @@ def traced_functions(
                     if isinstance(inner, ast.Name) and inner.id in by_name:
                         for fn in by_name[inner.id]:
                             mark(fn, _static_params(node, fn))
+        # lax control-flow combinators trace their function arguments:
+        # a scan/while/fori/cond body is a traced function with no
+        # static-argnames escape hatch. The name pre-check keeps the
+        # resolve() off the hot path — most calls pass no module-level
+        # function names at all (the 5s fast-lane bar)
+        if (
+            isinstance(node, ast.Call)
+            and any(
+                isinstance(a, ast.Name) and a.id in by_name
+                for a in node.args
+            )
+            and imports.resolve(node.func) in _LAX_BODY_WRAPPERS
+        ):
+            for arg in node.args:
+                if isinstance(arg, ast.Name) and arg.id in by_name:
+                    for fn in by_name[arg.id]:
+                        mark(fn, set())
     return traced
 
 
@@ -152,7 +194,12 @@ class JitHostSyncRule(Rule):
 
     def check(self, module: SourceModule) -> List[Finding]:
         # sound prefilter: a traced function requires one of these tokens
-        if not any(t in module.text for t in ("jit", "pmap", "vmap", "vectorize")):
+        # ("lax" without the dot: `from jax.lax import while_loop` never
+        # contains "lax." — soundness beats the few extra admissions)
+        if not any(
+            t in module.text
+            for t in ("jit", "pmap", "vmap", "vectorize", "lax")
+        ):
             return []
         imports = import_map_for(module)
         traced_fns = traced_functions_for(module)
@@ -220,6 +267,43 @@ class JitHostSyncRule(Rule):
                 )
             )
 
+        def cast_arg_traced(node: ast.AST) -> bool:
+            """Can this expression's VALUE be a tracer? Static metadata
+            extractors shield: ``len(x)``, ``x.shape``/``ndim``/``size``/
+            ``dtype`` are concrete at trace time even on a tracer, so
+            ``float(x.shape[0])`` stays legal while ``float(x[0])`` and
+            ``float(x.sum())`` are flagged."""
+            if isinstance(node, ast.Name):
+                return node.id in traced
+            if isinstance(node, ast.Attribute):
+                if node.attr in _STATIC_TRACER_ATTRS:
+                    return False
+                return cast_arg_traced(node.value)
+            if isinstance(node, ast.Subscript):
+                return cast_arg_traced(node.value)
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Name) and node.func.id == "len":
+                    return False
+                parts = [node.func, *node.args]
+                parts += [kw.value for kw in node.keywords]
+                return any(cast_arg_traced(p) for p in parts)
+            if isinstance(node, ast.BinOp):
+                return cast_arg_traced(node.left) or cast_arg_traced(node.right)
+            if isinstance(node, ast.UnaryOp):
+                return cast_arg_traced(node.operand)
+            # anything else (constants, tuples, comprehensions): quiet —
+            # the rule stays conservative on forms it cannot judge
+            return False
+
+        #: BoolOp nodes already judged as an If/While/IfExp/Assert test —
+        #: the owning statement reports them; the generic and/or check
+        #: below must not double-flag the same coercion
+        judged_tests = set()
+        for node in fn_nodes:
+            if isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+                if isinstance(node.test, ast.BoolOp):
+                    judged_tests.add(id(node.test))
+
         for node in fn_nodes:
             if isinstance(node, ast.Call):
                 callee = imports.resolve(node.func)
@@ -227,8 +311,7 @@ class JitHostSyncRule(Rule):
                     isinstance(node.func, ast.Name)
                     and node.func.id in _CASTS
                     and node.args
-                    and isinstance(node.args[0], ast.Name)
-                    and node.args[0].id in traced
+                    and cast_arg_traced(node.args[0])
                 ):
                     flag(node, f"{node.func.id}()")
                 elif (
@@ -250,13 +333,21 @@ class JitHostSyncRule(Rule):
                     and refs_traced(node.func.value)
                 ):
                     flag(node, f".{node.func.attr}()")
-            elif isinstance(node, (ast.If, ast.While)):
+            elif isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
                 # only bare traced names as direct operands: `if x:` /
                 # `if x > 0:` are tracer bool-coercions; `if f(x) ...` is
-                # left alone (f may be static — shape math, trained_split)
+                # left alone (f may be static — shape math, trained_split).
+                # IfExp (`a if x else b`) and Assert are the same implicit
+                # __bool__ wearing expression/statement clothes.
                 test = node.test
                 operands: List[ast.expr] = [test]
                 if isinstance(test, ast.Compare):
+                    if all(
+                        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+                    ):
+                        # `x is None` on a tracer is Python IDENTITY — a
+                        # static trace-time fact, no __bool__ coercion
+                        continue
                     operands = [test.left, *test.comparators]
                 elif isinstance(test, ast.BoolOp):
                     operands = list(test.values)
@@ -265,5 +356,21 @@ class JitHostSyncRule(Rule):
                 if any(
                     isinstance(op, ast.Name) and op.id in traced for op in operands
                 ):
-                    flag(node, "Python branch")
+                    what = (
+                        "Python branch" if isinstance(node, (ast.If, ast.While))
+                        else "conditional expression"
+                        if isinstance(node, ast.IfExp) else "assert"
+                    )
+                    flag(node, what)
+            elif (
+                isinstance(node, ast.BoolOp)
+                and id(node) not in judged_tests
+                and any(
+                    isinstance(v, ast.Name) and v.id in traced
+                    for v in node.values
+                )
+            ):
+                # bare `x and y` / `x or y` on a tracer coerces __bool__
+                # exactly like `if x:` — the short-circuit needs a value
+                flag(node, "and/or")
         return findings

@@ -90,6 +90,7 @@ from hpbandster_tpu.obs.audit import (  # noqa: F401
     emit_bracket_promotion,
     emit_config_sampled,
     emit_promotion_decision,
+    emit_sweep_incumbent,
     note_straggler,
 )
 from hpbandster_tpu.obs.events import (  # noqa: F401
@@ -111,6 +112,7 @@ from hpbandster_tpu.obs.events import (  # noqa: F401
     RESULT_DELIVERED,
     RESULT_REPLAYED,
     RPC_RETRY,
+    SWEEP_INCUMBENT,
     UNKNOWN_RESULT,
     WORKER_DISCOVERED,
     WORKER_DROPPED,
@@ -158,9 +160,11 @@ from hpbandster_tpu.obs.runtime import (  # noqa: F401
     DeviceSampler,
     get_compile_tracker,
     note_transfer,
+    publish_sweep_transfers,
     runtime_snapshot,
     start_device_sampler,
     tracked_jit,
+    transfer_counters,
 )
 from hpbandster_tpu.obs.trace import (  # noqa: F401
     DEFAULT_TENANT,
@@ -191,9 +195,11 @@ __all__ = [
     "AUDIT_EVENTS", "AUDIT_RULE_FIELDS", "config_lineage",
     "emit_bracket_created", "emit_bracket_promotion",
     "emit_config_sampled", "emit_promotion_decision",
+    "emit_sweep_incumbent",
     "note_straggler", "drain_stragglers",
     "CompileTracker", "DeviceSampler", "get_compile_tracker",
-    "note_transfer", "runtime_snapshot", "start_device_sampler",
+    "note_transfer", "publish_sweep_transfers", "transfer_counters",
+    "runtime_snapshot", "start_device_sampler",
     "tracked_jit",
     "FleetCollector", "derive_fleet", "format_fleet_table", "read_series",
     "ProfileSession", "get_profile_session", "device_peaks",
@@ -207,7 +213,7 @@ __all__ = [
     "CONFIG_SAMPLED", "PROMOTION_DECISION", "ALERT", "XLA_COMPILE",
     "FLEET_SAMPLE",
     "JOB_REQUEUED", "RESULT_REPLAYED", "DUPLICATE_RESULT",
-    "WORKER_QUARANTINED", "CHAOS_FAULT",
+    "WORKER_QUARANTINED", "CHAOS_FAULT", "SWEEP_INCUMBENT",
 ]
 
 

@@ -48,6 +48,7 @@ __all__ = [
     "emit_bracket_promotion",
     "emit_config_sampled",
     "emit_promotion_decision",
+    "emit_sweep_incumbent",
     "note_straggler",
     "drain_stragglers",
     "config_key",
@@ -55,7 +56,9 @@ __all__ = [
 ]
 
 #: the audit vocabulary (subset of ``obs.EVENT_TYPES``)
-AUDIT_EVENTS = frozenset({E.CONFIG_SAMPLED, E.PROMOTION_DECISION})
+AUDIT_EVENTS = frozenset(
+    {E.CONFIG_SAMPLED, E.PROMOTION_DECISION, E.SWEEP_INCUMBENT}
+)
 
 #: promotion-audit field names only the dedicated emitters below may
 #: stamp (the ``obs-reserved-fields`` graftlint rule enforces it for
@@ -300,6 +303,61 @@ def emit_promotion_decision(
     if flagged:
         fields["straggler_observed"] = [list(k) for k in flagged]
     E.emit(E.PROMOTION_DECISION, **fields)
+
+
+def emit_sweep_incumbent(
+    vector: Sequence[float],
+    loss: Optional[float],
+    bracket: int,
+    per_bracket_loss: Sequence[Optional[float]],
+    evaluations: Optional[int] = None,
+    n_configs: Optional[int] = None,
+    d2h_bytes: Optional[int] = None,
+    h2d_bytes: Optional[int] = None,
+    host_syncs: Optional[int] = None,
+) -> None:
+    """Journal a resident (incumbent-only) sweep's single device->host
+    payload — the ONE decision record such a sweep produces.
+
+    When the whole HyperBand outer loop runs in-trace
+    (``ops/sweep.py`` ``resident=True`` + ``incumbent_only=True``),
+    per-rung promotion decisions never leave the device; this record
+    carries everything that did: the winning configuration vector, its
+    final-stage loss, which bracket produced it, and each bracket's best
+    final loss — enough for ``obs replay`` to re-score the incumbent
+    pick against the per-bracket bests (the regret surface that remains
+    when per-rung candidates were never materialized host-side). The
+    per-sweep transfer accounting (``d2h_bytes``/``h2d_bytes``/
+    ``host_syncs``, from :func:`obs.runtime.publish_sweep_transfers`)
+    rides along so the flat-d2h claim is replayable from the journal.
+
+    Non-finite losses journal as None (strict-JSON rule, like the
+    master's loss-carrying records).
+    """
+
+    def _j(v: Any) -> Optional[float]:
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            v = float(v)
+            return v if v == v and v not in (float("inf"), float("-inf")) else None
+        return None
+
+    fields: Dict[str, Any] = {
+        "vector": [_j(x) for x in vector],
+        "loss": _j(loss),
+        "bracket": int(bracket),
+        "per_bracket_loss": [_j(l) for l in per_bracket_loss],
+    }
+    if evaluations is not None:
+        fields["evaluations"] = int(evaluations)
+    if n_configs is not None:
+        fields["n_configs"] = int(n_configs)
+    if d2h_bytes is not None:
+        fields["d2h_bytes"] = int(d2h_bytes)
+    if h2d_bytes is not None:
+        fields["h2d_bytes"] = int(h2d_bytes)
+    if host_syncs is not None:
+        fields["host_syncs"] = int(host_syncs)
+    E.emit(E.SWEEP_INCUMBENT, **fields)
 
 
 # ------------------------------------------------------------------ replay

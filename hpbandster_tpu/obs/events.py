@@ -64,6 +64,7 @@ __all__ = [
     "DUPLICATE_RESULT",
     "WORKER_QUARANTINED",
     "CHAOS_FAULT",
+    "SWEEP_INCUMBENT",
 ]
 
 logger = logging.getLogger("hpbandster_tpu.obs")
@@ -110,6 +111,12 @@ WORKER_QUARANTINED = "worker_quarantined"
 #: one injected fault from the chaos harness (parallel/chaos.py):
 #: kind in {kill, delay, drop, duplicate}
 CHAOS_FAULT = "chaos_fault"
+#: the resident (incumbent-only) sweep's single device->host payload,
+#: journaled: winning vector/loss/bracket plus each bracket's best final
+#: loss — the ONLY decision record a sweep whose per-rung decisions
+#: never left the device produces (obs/audit.py emit_sweep_incumbent;
+#: `obs replay` re-scores it)
+SWEEP_INCUMBENT = "sweep_incumbent"
 
 #: the core vocabulary (docs/observability.md "Event schema"). emit() also
 #: accepts names outside this set — subsystems may add their own (span
@@ -120,7 +127,7 @@ EVENT_TYPES = frozenset({
     RPC_RETRY, RESULT_DELIVERED, CHECKPOINT_WRITTEN, UNKNOWN_RESULT,
     CONFIG_SAMPLED, PROMOTION_DECISION, ALERT, XLA_COMPILE, FLEET_SAMPLE,
     JOB_REQUEUED, RESULT_REPLAYED, DUPLICATE_RESULT, WORKER_QUARANTINED,
-    CHAOS_FAULT,
+    CHAOS_FAULT, SWEEP_INCUMBENT,
 })
 
 #: process-wide kill switch (hpbandster_tpu.obs.set_enabled)

@@ -85,6 +85,13 @@ _LABEL_RULES: Tuple[Tuple[re.Pattern, str, object], ...] = (
     # padding family fleet.device_compute_skew is derived from
     (re.compile(r"^sweep\.device\.(?P<label>\d+)\.(?P<field>[a-z_]+)$"),
      "sweep_device_{field}", "device"),
+    # per-sweep host-link byte gauges (obs/runtime.py
+    # publish_sweep_transfers): sweep.transfer_bytes.h2d ->
+    # sweep_transfer_bytes{direction="h2d"} — one labeled family so a
+    # scraper can plot both directions on one panel; the resident
+    # sweep's flat-d2h acceptance reads this gauge
+    (re.compile(r"^sweep\.transfer_bytes\.(?P<label>h2d|d2h)$"),
+     "sweep_transfer_bytes", "direction"),
     (re.compile(r"^runtime\.compiles\.(?P<label>.+)$", re.DOTALL),
      "runtime_fn_compiles", "fn"),
     # roofline/cost families (obs/runtime.py _TrackedLowered cost

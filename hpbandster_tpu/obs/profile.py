@@ -47,6 +47,7 @@ __all__ = [
     "device_peaks",
     "roofline_report",
     "format_roofline",
+    "transfer_summary",
 ]
 
 #: per-chip HBM bandwidth (bytes/s) by ``device.device_kind`` prefix —
@@ -191,10 +192,43 @@ def device_peaks(device: Any = None) -> Dict[str, Optional[float]]:
     }
 
 
+def transfer_summary(
+    registry: Any = None,
+) -> Optional[Dict[str, Any]]:
+    """Host-link transfer view for the roofline report: process-lifetime
+    byte/buffer counters (``obs.runtime.note_transfer``) plus the
+    last-sweep gauges (``obs.runtime.publish_sweep_transfers``), or None
+    when the process never counted a transfer. Registry-read only —
+    never initializes jax."""
+    from hpbandster_tpu.obs.metrics import get_metrics
+
+    reg = registry if registry is not None else get_metrics()
+    snap = reg.snapshot()
+    counters = snap.get("counters") or {}
+    gauges = snap.get("gauges") or {}
+    total = {
+        k: int(counters.get(f"runtime.{k}", 0) or 0)
+        for k in ("transfer_bytes_h2d", "transfer_bytes_d2h",
+                  "transfers_h2d", "transfers_d2h")
+    }
+    if not any(total.values()) and "sweep.transfer_bytes.d2h" not in gauges:
+        return None
+    out: Dict[str, Any] = {"process_total": total}
+    last_sweep = {
+        "h2d_bytes": gauges.get("sweep.transfer_bytes.h2d"),
+        "d2h_bytes": gauges.get("sweep.transfer_bytes.d2h"),
+        "host_syncs": gauges.get("sweep.host_syncs"),
+    }
+    if any(v is not None for v in last_sweep.values()):
+        out["last_sweep"] = last_sweep
+    return out
+
+
 def roofline_report(
     tracker: Any = None,
     peaks: Optional[Dict[str, Optional[float]]] = None,
     seconds_by_program: Optional[Dict[str, float]] = None,
+    transfers: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Attribute FLOPs/bytes per compiled program in the compile ledger.
 
@@ -271,10 +305,15 @@ def roofline_report(
                 row["utilization_vs_peak"] = round(achieved / peak_f, 4)
         programs.append(row)
     programs.sort(key=lambda r: (r["fn"], str(r["signature"])))
+    if transfers is None:
+        transfers = transfer_summary()
     return {
         "peak": peaks,
         "programs": programs,
         "program_count": len(programs),
+        # the host-link half of the roofline story: FLOPs/bytes above are
+        # what the device did; this is what crossed the host link doing it
+        "transfers": transfers,
         "caveats": [] if peak_f else [
             "no peak FLOP/s table entry for this device kind "
             "(CPU backends especially): intensities are exact, but "
@@ -317,6 +356,25 @@ def format_roofline(report: Dict[str, Any]) -> str:
     if not report.get("programs"):
         lines.append("(no costed programs in the compile ledger — run an "
                      "AOT-compiled path first, e.g. a bucketed schedule)")
+    transfers = report.get("transfers")
+    if transfers:
+        total = transfers.get("process_total") or {}
+        lines.append(
+            "host link (process): h2d {} / {} buffers, d2h {} / {} buffers".format(
+                _si(total.get("transfer_bytes_h2d")),
+                _si(total.get("transfers_h2d")),
+                _si(total.get("transfer_bytes_d2h")),
+                _si(total.get("transfers_d2h")),
+            )
+        )
+        last = transfers.get("last_sweep")
+        if last:
+            lines.append(
+                "host link (last sweep): h2d {}, d2h {}, {} host sync(s)".format(
+                    _si(last.get("h2d_bytes")), _si(last.get("d2h_bytes")),
+                    _si(last.get("host_syncs")),
+                )
+            )
     for c in report.get("caveats") or []:
         lines.append(f"note: {c}")
     return "\n".join(lines)

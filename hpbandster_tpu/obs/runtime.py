@@ -58,9 +58,11 @@ __all__ = [
     "compile_stats_from_records",
     "get_compile_tracker",
     "note_transfer",
+    "publish_sweep_transfers",
     "runtime_snapshot",
     "start_device_sampler",
     "tracked_jit",
+    "transfer_counters",
 ]
 
 logger = logging.getLogger("hpbandster_tpu.obs")
@@ -469,6 +471,67 @@ def note_transfer(
     reg = registry if registry is not None else get_metrics()
     reg.counter(f"runtime.transfers_{direction}").inc(int(buffers))
     reg.counter(f"runtime.transfer_bytes_{direction}").inc(max(int(nbytes), 0))
+
+
+#: the four process-lifetime host-link counters :func:`note_transfer`
+#: advances — the ONE name list shared by the per-sweep snapshot/diff
+#: below and anything else that wants to read the link bill
+_TRANSFER_COUNTER_KEYS = (
+    "transfers_h2d", "transfers_d2h",
+    "transfer_bytes_h2d", "transfer_bytes_d2h",
+)
+
+
+def transfer_counters(
+    registry: Optional[MetricsRegistry] = None,
+) -> Dict[str, int]:
+    """Current values of the host-link transfer counters (0 where never
+    advanced) — snapshot one before a sweep, diff with
+    :func:`publish_sweep_transfers` after."""
+    reg = registry if registry is not None else get_metrics()
+    counters = reg.snapshot().get("counters") or {}
+    return {
+        k: int(counters.get(f"runtime.{k}", 0) or 0)
+        for k in _TRANSFER_COUNTER_KEYS
+    }
+
+
+def publish_sweep_transfers(
+    before: Dict[str, int],
+    registry: Optional[MetricsRegistry] = None,
+) -> Dict[str, int]:
+    """Per-sweep device<->host byte accounting: diff the transfer
+    counters against a :func:`transfer_counters` snapshot taken at sweep
+    start, publish the result as gauges, and return the deltas.
+
+    Gauges (exported to Prometheus as ``sweep_transfer_bytes{direction=}``
+    and ``hpbandster_sweep_host_syncs`` via ``obs/export.py``):
+
+    * ``sweep.transfer_bytes.h2d`` / ``sweep.transfer_bytes.d2h`` — bytes
+      the host link carried for the LAST sweep. The resident sweep's
+      flatness claim lives here: in incumbent-only mode d2h must not
+      scale with config count (one vector + one scalar per sweep);
+    * ``sweep.host_syncs`` — transferred-BUFFER count (both directions,
+      the unit every ``note_transfer`` site counts in: a fetch of one
+      4-leaf payload counts 4): the sweep's host-surface bill, which the
+      resident-loop bench tier pins constant in config count.
+
+    Counts only the repo's own :func:`note_transfer` choke points — the
+    set whose round-trips dominate on high-latency links.
+    """
+    reg = registry if registry is not None else get_metrics()
+    now = transfer_counters(reg)
+    delta = {k: now[k] - int(before.get(k, 0)) for k in now}
+    reg.gauge("sweep.transfer_bytes.h2d").set(
+        float(delta["transfer_bytes_h2d"])
+    )
+    reg.gauge("sweep.transfer_bytes.d2h").set(
+        float(delta["transfer_bytes_d2h"])
+    )
+    reg.gauge("sweep.host_syncs").set(
+        float(delta["transfers_h2d"] + delta["transfers_d2h"])
+    )
+    return delta
 
 
 # ------------------------------------------------------------- device sampler

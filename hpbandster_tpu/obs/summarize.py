@@ -224,6 +224,9 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     t_wall_min: Optional[float] = None
     t_wall_max: Optional[float] = None
     seen_job_keys: set = set()
+    #: host-link bill carried by sweep-level records (``sweep_chunk`` /
+    #: ``sweep_incumbent`` stamp h2d_bytes/d2h_bytes/host_syncs)
+    link = {"records": 0, "h2d_bytes": 0, "d2h_bytes": 0, "host_syncs": 0}
 
     def worker_slot(name: str) -> Dict[str, float]:
         return workers.setdefault(
@@ -263,6 +266,14 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                     slot["failed"] += 1
         elif isinstance(rec.get("duration_s"), (int, float)):
             spans.setdefault(name, []).append(rec["duration_s"])
+        if isinstance(rec.get("h2d_bytes"), (int, float)) or isinstance(
+            rec.get("d2h_bytes"), (int, float)
+        ):
+            link["records"] += 1
+            for field in ("h2d_bytes", "d2h_bytes", "host_syncs"):
+                v = rec.get(field)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    link[field] += int(v)
 
     window_s = (
         (t_wall_max - t_wall_min)
@@ -302,6 +313,9 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "stage_latency_s": stages,
         "worker_utilization": utilization,
         "runtime": runtime,
+        # device<->host byte accounting, when any sweep-level record
+        # carried it (the resident tier's flat-d2h evidence in journal form)
+        "host_link": link if link["records"] else None,
         "failures": {
             "jobs_failed": counts.get(E.JOB_FAILED, 0),
             "rpc_retries": counts.get(E.RPC_RETRY, 0),
@@ -357,6 +371,17 @@ def format_summary(s: Dict[str, Any]) -> str:
                 f"  {row['fn']}: {row['compiles']} compiles, "
                 f"{row['compile_s']:.3f}s"
             )
+    link = s.get("host_link")
+    if link:
+        lines.append("")
+        lines.append(
+            "host link: h2d %s, d2h %s over %d sweep record(s), "
+            "%d host sync(s)"
+            % (
+                _fmt_bytes(link["h2d_bytes"]), _fmt_bytes(link["d2h_bytes"]),
+                link["records"], link["host_syncs"],
+            )
+        )
     lines.append("")
     f = s["failures"]
     lines.append(

@@ -57,7 +57,23 @@ from hpbandster_tpu.ops.kde import (
 __all__ = ["SpaceCodec", "build_space_codec", "quantize_unit", "random_unit",
            "random_unit_sharded", "compile_active_mask",
            "compile_forbidden_mask", "make_fused_sweep_fn",
-           "SweepBracketOutput", "SweepIncumbent", "plan_additions"]
+           "SweepBracketOutput", "SweepIncumbent", "plan_additions",
+           "pow2_capacities", "ResidentSweepOutputs", "resident_rotation",
+           "unstack_resident_outputs"]
+
+
+def pow2_capacities(counts: dict, floor: int = 256) -> dict:
+    """Pow2-bucketed observation capacities with a generous floor — THE
+    one definition of the dynamic tier's buffer-shape policy (see the
+    rationale at the chunked driver's call site): ``FusedBOHB.run`` /
+    ``run_incumbent``, the sharded driver, and the parity tests must all
+    agree on it or executable sharing (and the checkpoint-resume shape
+    guarantee) silently breaks."""
+    floor = max(int(floor), 1)
+    return {
+        float(b): 1 << max(int(n) - 1, floor - 1).bit_length()
+        for b, n in counts.items()
+    }
 
 
 def plan_additions(plans: Sequence[BracketPlan]) -> dict:
@@ -600,6 +616,59 @@ class SweepIncumbent(NamedTuple):
     per_bracket_loss: jax.Array
 
 
+class ResidentSweepOutputs(NamedTuple):
+    """Full (non-incumbent) outputs of a ``resident=True`` sweep.
+
+    ``stacked`` holds one :class:`SweepBracketOutput` per ROTATION
+    position whose leaves carry a leading round axis (``lax.scan``'s
+    stacking); ``tail`` holds the per-bracket outputs of the partial
+    final round, unrolled. :func:`unstack_resident_outputs` flattens
+    both into the per-bracket list the unrolled sweep returns.
+    """
+
+    stacked: Tuple[SweepBracketOutput, ...]
+    tail: Tuple[SweepBracketOutput, ...]
+
+
+def resident_rotation(plans: Sequence[BracketPlan]) -> Tuple[int, int, int]:
+    """``(period, n_rounds, n_tail)`` of a bracket schedule.
+
+    The HyperBand rotation repeats its bracket shapes with a short
+    period, so the resident sweep traces ONE round and ``lax.scan``-s it:
+    program size O(period), not O(brackets). ``period`` is the smallest
+    ``p`` with ``plans[i] == plans[i - p]`` for every ``i >= p`` (falls
+    back to ``len(plans)`` for an aperiodic schedule — the scan then has
+    a single round and the resident program degenerates to the unrolled
+    one); ``n_tail = len(plans) - period * n_rounds`` brackets of the
+    partial last round run unrolled after the scan.
+    """
+    plans = [BracketPlan(tuple(p.num_configs), tuple(p.budgets)) for p in plans]
+    n = len(plans)
+    if n == 0:
+        raise ValueError("resident rotation needs at least one bracket")
+    period = n
+    for cand in range(1, n):
+        if all(plans[i] == plans[i - cand] for i in range(cand, n)):
+            period = cand
+            break
+    n_rounds = n // period
+    return period, n_rounds, n - period * n_rounds
+
+
+def unstack_resident_outputs(
+    raw: ResidentSweepOutputs, n_rounds: int
+) -> List[SweepBracketOutput]:
+    """Flatten a (fetched) :class:`ResidentSweepOutputs` into the flat
+    per-bracket output list the unrolled sweep returns, in bracket order
+    (round-major over the rotation, then the tail)."""
+    outs: List[SweepBracketOutput] = []
+    for r in range(int(n_rounds)):
+        for pos_out in raw.stacked:
+            outs.append(SweepBracketOutput(*(leaf[r] for leaf in pos_out)))
+    outs.extend(SweepBracketOutput(*o) for o in raw.tail)
+    return outs
+
+
 #: device imputation moved to ops/kde.py (the in-trace refit op needs it
 #: too); the old private name stays importable for existing callers
 _impute_conditional_device = impute_conditional_masked
@@ -667,6 +736,7 @@ def make_fused_sweep_fn(
     return_state: bool = False,
     shard_sampling: bool = False,
     incumbent_only: bool = False,
+    resident: bool = False,
 ) -> Callable[..., List[SweepBracketOutput]]:
     """Trace + jit the whole sweep; returns ``fn(seed[, warm_v, warm_l])``.
 
@@ -724,6 +794,27 @@ def make_fused_sweep_fn(
     the final incumbent needs to leave the device loop. With
     ``return_state`` the fn returns ``(incumbent, state)``.
 
+    ``resident=True`` is the whole-outer-loop fusion (ROADMAP "in-trace
+    everything at 1M"): instead of unrolling every bracket into the
+    trace (program size O(brackets); a chunked driver then surfaces to
+    host per chunk), the HyperBand rotation's repeating round of bracket
+    shapes is traced ONCE and driven by an in-trace ``lax.scan`` over
+    rounds — bracket rotation, KDE refit (the traced-count
+    ``fit_kde_pair_masked`` path), rung promotion, observation-state
+    threading and the incumbent update all stay device-resident across
+    the whole schedule. Requires ``dynamic_counts=True`` (observation
+    counts evolve across scan iterations, so they must be traced). With
+    ``incumbent_only=True`` the entire sweep's device->host traffic is
+    one seed up and one :class:`SweepIncumbent` down, whatever the
+    config count; without it the fn returns
+    :class:`ResidentSweepOutputs` (scan-stacked per-rotation-position
+    outputs + the unrolled tail) — flatten with
+    :func:`unstack_resident_outputs`. Bracket ``b_i``'s RNG key is
+    ``fold_in(key, b_i)`` with a TRACED ``b_i`` of the same value the
+    unrolled trace folds concretely, so the resident and unrolled
+    dynamic tiers are bit-identical on the same seed and capacities
+    (the parity bar in ``tests/test_resident.py``).
+
     ``return_state=True`` (dynamic tier only) makes the jitted fn ALSO
     return the end-of-sweep observation state ``(obs_v, obs_l, counts)``
     — the same pytrees the warm inputs arrived as — so a chunked driver
@@ -752,6 +843,13 @@ def make_fused_sweep_fn(
         raise ValueError("shard_sampling=True requires a mesh")
     if incumbent_only and not plans:
         raise ValueError("incumbent_only=True needs at least one bracket")
+    if resident and not dynamic_counts:
+        raise ValueError(
+            "resident=True requires dynamic_counts=True: the scan carries "
+            "observation counts across rounds, so they must be traced"
+        )
+    if resident and not plans:
+        raise ValueError("resident=True needs at least one bracket")
     n_shards = shard_count(mesh, axis) if shard_sampling else 1
     if n_shards > 1:
         for p in plans:
@@ -867,10 +965,15 @@ def make_fused_sweep_fn(
         proposals = jnp.where(mb_mask[:, None], model_vecs, rand_vecs)
         return proposals, mb_mask
 
-    def sweep(
-        seed: jax.Array, warm_v=None, warm_l=None, warm_n=None
-    ) -> List[SweepBracketOutput]:
-        key = jax.random.key(seed)
+    if resident:
+        rotation, n_rounds, _tail_count = resident_rotation(plans)
+        round_plans = plans[:rotation]
+        tail_plans = plans[rotation * n_rounds:]
+
+    def init_obs_state(warm_v, warm_l, warm_n):
+        """Seed the per-budget observation buffers: full-capacity with
+        traced counts on the dynamic tier, exact-count slices burned into
+        the trace on the static tier."""
         if dynamic_counts:
             # full-capacity buffers in, traced counts; pad slots pinned to
             # (0-vector, +inf loss) regardless of what the caller sent.
@@ -932,178 +1035,256 @@ def make_fused_sweep_fn(
                     )
                 )
                 counts[b] = n
-        outputs: List[SweepBracketOutput] = []
-        if incumbent_only:
-            best_key = jnp.asarray(jnp.inf, jnp.float32)
-            best_loss = jnp.asarray(jnp.nan, jnp.float32)
-            best_vec = jnp.zeros((d,), jnp.float32)
-            best_bracket = jnp.asarray(-1, jnp.int32)
-            per_bracket: List[jax.Array] = []
+        return obs_v, obs_l, counts
 
-        for b_i, plan in enumerate(plans):
-            n0 = plan.num_configs[0]
-            k_rand, k_prop, k_frac, k_fit = jax.random.split(
-                jax.random.fold_in(key, b_i), 4
-            )
-            # per-shard derivation under shard_sampling: each shard's rows
-            # come from its own folded key, so generation stays local to
-            # the owning device (n_shards == 1 falls through to the
-            # unfolded base key — the 1-device-mesh bit-parity contract)
-            rand_vecs = random_unit_sharded(codec, k_rand, n0, n_shards)
-            if n_shards > 1:
-                rand_vecs = shard_rows(rand_vecs, mesh, axis)
+    def init_incumbent():
+        """(best_key, best_loss, best_vec, best_bracket, per_bracket) —
+        the cross-bracket incumbent fold's carry. ``per_bracket`` is a
+        fixed f32[len(plans)] written at the bracket's index (the array
+        form both the unrolled loop and the resident scan can update)."""
+        return (
+            jnp.asarray(jnp.inf, jnp.float32),
+            jnp.asarray(jnp.nan, jnp.float32),
+            jnp.zeros((d,), jnp.float32),
+            jnp.asarray(-1, jnp.int32),
+            jnp.zeros((len(plans),), jnp.float32),
+        )
 
-            if dynamic_counts:
-                if not any_trainable:
-                    # no budget's gate can open even at full capacity
-                    # (FusedHyperBand/RandomSearch) — skip tracing the
-                    # model math entirely
-                    proposals = rand_vecs
-                    mb_mask = jnp.zeros(n0, bool)
-                else:
-                    proposals, mb_mask = dynamic_proposals(
-                        obs_v, obs_l, counts, rand_vecs, k_prop, k_frac,
-                        k_fit, n0,
-                    )
+    def run_bracket(b_i, plan, key, obs_v, obs_l, counts, inc):
+        """One bracket: sample/propose -> forbidden resampling -> fused
+        rung ladder -> observation append -> incumbent fold.
+
+        ``b_i`` may be a Python int (the unrolled trace) or a traced i32
+        (the resident scan's round arithmetic): ``fold_in`` is
+        value-deterministic, so both derive identical draws for the same
+        bracket index — the resident/unrolled bit-parity contract.
+        Functional: returns updated ``(obs_v, obs_l, counts, inc, out)``
+        without mutating the caller's dicts (the scan carry requires it);
+        ``out`` is the bracket's :class:`SweepBracketOutput` or ``None``
+        under ``incumbent_only``.
+        """
+        obs_v, obs_l, counts = dict(obs_v), dict(obs_l), dict(counts)
+        n0 = plan.num_configs[0]
+        k_rand, k_prop, k_frac, k_fit = jax.random.split(
+            jax.random.fold_in(key, b_i), 4
+        )
+        # per-shard derivation under shard_sampling: each shard's rows
+        # come from its own folded key, so generation stays local to
+        # the owning device (n_shards == 1 falls through to the
+        # unfolded base key — the 1-device-mesh bit-parity contract)
+        rand_vecs = random_unit_sharded(codec, k_rand, n0, n_shards)
+        if n_shards > 1:
+            rand_vecs = shard_rows(rand_vecs, mesh, axis)
+
+        if dynamic_counts:
+            if not any_trainable:
+                # no budget's gate can open even at full capacity
+                # (FusedHyperBand/RandomSearch) — skip tracing the
+                # model math entirely
+                proposals = rand_vecs
+                mb_mask = jnp.zeros(n0, bool)
             else:
-                model_budget = None
-                for b in sorted(caps, reverse=True):
-                    if trained_split(counts[b]) is not None:
-                        model_budget = b
-                        break
+                proposals, mb_mask = dynamic_proposals(
+                    obs_v, obs_l, counts, rand_vecs, k_prop, k_frac,
+                    k_fit, n0,
+                )
+        else:
+            model_budget = None
+            for b in sorted(caps, reverse=True):
+                if trained_split(counts[b]) is not None:
+                    model_budget = b
+                    break
 
-                if model_budget is None:
-                    proposals = rand_vecs
-                    mb_mask = jnp.zeros(n0, bool)
-                else:
-                    n = counts[model_budget]
-                    n_good, n_bad = trained_split(n)
-                    good, bad = _fit_kde_pair_device(
-                        obs_v[model_budget][:n], obs_l[model_budget][:n],
-                        n_good, n_bad, cards_dev, min_bandwidth,
-                        impute_key=k_fit if active_mask_fn is not None else None,
-                    )
-                    model_vecs = _propose_model_vecs(good, bad, k_prop, n0)
-                    mb_mask = (
-                        jax.random.uniform(k_frac, (n0,)) >= random_fraction
-                    )
-                    proposals = jnp.where(
-                        mb_mask[:, None], model_vecs, rand_vecs
-                    )
+            if model_budget is None:
+                proposals = rand_vecs
+                mb_mask = jnp.zeros(n0, bool)
+            else:
+                n = counts[model_budget]
+                n_good, n_bad = trained_split(n)
+                good, bad = _fit_kde_pair_device(
+                    obs_v[model_budget][:n], obs_l[model_budget][:n],
+                    n_good, n_bad, cards_dev, min_bandwidth,
+                    impute_key=k_fit if active_mask_fn is not None else None,
+                )
+                model_vecs = _propose_model_vecs(good, bad, k_prop, n0)
+                mb_mask = (
+                    jax.random.uniform(k_frac, (n0,)) >= random_fraction
+                )
+                proposals = jnp.where(
+                    mb_mask[:, None], model_vecs, rand_vecs
+                )
 
-            vectors = quantize_unit(codec, proposals)
+        vectors = quantize_unit(codec, proposals)
 
-            if forbidden_fn is not None:
-                # in-trace rejection resampling (bounded, static shapes):
-                # redraw forbidden rows uniformly; anything still forbidden
-                # after the retry budget clamps to the known-valid fallback
-                def batch_act(vecs):
-                    if active_mask_fn is not None:
-                        return jax.vmap(active_mask_fn)(vecs)
-                    return jnp.ones(vecs.shape, bool)
+        if forbidden_fn is not None:
+            # in-trace rejection resampling (bounded, static shapes):
+            # redraw forbidden rows uniformly; anything still forbidden
+            # after the retry budget clamps to the known-valid fallback
+            def batch_act(vecs):
+                if active_mask_fn is not None:
+                    return jax.vmap(active_mask_fn)(vecs)
+                return jnp.ones(vecs.shape, bool)
 
-                k_forb = jax.random.fold_in(k_rand, 0x7FB)
-                resampled = jnp.zeros(n0, bool)
-                for t in range(max_forbidden_retries):
-                    forbidden_rows = jax.vmap(forbidden_fn)(
-                        vectors, batch_act(vectors)
-                    )
-                    resampled = resampled | forbidden_rows
-                    fresh = quantize_unit(
-                        codec,
-                        random_unit(codec, jax.random.fold_in(k_forb, t), n0),
-                    )
-                    vectors = jnp.where(
-                        forbidden_rows[:, None], fresh, vectors
-                    )
+            k_forb = jax.random.fold_in(k_rand, 0x7FB)
+            resampled = jnp.zeros(n0, bool)
+            for t in range(max_forbidden_retries):
                 forbidden_rows = jax.vmap(forbidden_fn)(
                     vectors, batch_act(vectors)
                 )
-                fb = quantize_unit(
-                    codec, jnp.asarray(fallback_vector, jnp.float32)
+                resampled = resampled | forbidden_rows
+                fresh = quantize_unit(
+                    codec,
+                    random_unit(codec, jax.random.fold_in(k_forb, t), n0),
                 )
                 vectors = jnp.where(
-                    forbidden_rows[:, None], fb[None, :], vectors
+                    forbidden_rows[:, None], fresh, vectors
                 )
-                # a redrawn/clamped row is uniform (or the fallback), not a
-                # model pick — don't let it masquerade as model-based in
-                # config_info / analysis
-                mb_mask = mb_mask & ~resampled
+            forbidden_rows = jax.vmap(forbidden_fn)(
+                vectors, batch_act(vectors)
+            )
+            fb = quantize_unit(
+                codec, jnp.asarray(fallback_vector, jnp.float32)
+            )
+            vectors = jnp.where(
+                forbidden_rows[:, None], fb[None, :], vectors
+            )
+            # a redrawn/clamped row is uniform (or the fallback), not a
+            # model pick — don't let it masquerade as model-based in
+            # config_info / analysis
+            mb_mask = mb_mask & ~resampled
 
-            if active_mask_fn is not None:
-                # conditional space: evaluation sees 0 in inactive dims
-                # (host parity: to_vector -> NaN -> nan_to_num(0)), while
-                # observations and outputs carry NaN so the host decoder
-                # and the KDE imputation see the true activity pattern
-                active = jax.vmap(active_mask_fn)(vectors)
-                eval_vectors = jnp.where(active, vectors, 0.0)
-                out_vectors = jnp.where(active, vectors, jnp.nan)
-            else:
-                eval_vectors = out_vectors = vectors
-            if mesh is not None:
-                from jax.sharding import NamedSharding, PartitionSpec
+        if active_mask_fn is not None:
+            # conditional space: evaluation sees 0 in inactive dims
+            # (host parity: to_vector -> NaN -> nan_to_num(0)), while
+            # observations and outputs carry NaN so the host decoder
+            # and the KDE imputation see the true activity pattern
+            active = jax.vmap(active_mask_fn)(vectors)
+            eval_vectors = jnp.where(active, vectors, 0.0)
+            out_vectors = jnp.where(active, vectors, jnp.nan)
+        else:
+            eval_vectors = out_vectors = vectors
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
 
-                eval_vectors = jax.lax.with_sharding_constraint(
-                    eval_vectors, NamedSharding(mesh, PartitionSpec(axis))
-                )
-
-            stages = fused_sh_bracket(
-                eval_fn, eval_vectors, plan.num_configs, plan.budgets,
-                rank_fn=rank_fn,
-                # per-stage sharding constraints: the rung ladder's
-                # survivor batches stay distributed over the config axis
-                # (promotion masks reduce across shards on-device)
-                mesh=mesh if shard_sampling else None, axis=axis,
+            eval_vectors = jax.lax.with_sharding_constraint(
+                eval_vectors, NamedSharding(mesh, PartitionSpec(axis))
             )
 
-            for (idx_s, losses_s), k_s, budget in zip(
-                stages, plan.num_configs, plan.budgets
-            ):
-                b = float(budget)
-                c = counts[b]
-                upd_l = jnp.where(jnp.isnan(losses_s), jnp.inf, losses_s)
-                if dynamic_counts:
-                    obs_v[b] = jax.lax.dynamic_update_slice_in_dim(
-                        obs_v[b], out_vectors[idx_s], c, 0
-                    )
-                    obs_l[b] = jax.lax.dynamic_update_slice_in_dim(
-                        obs_l[b], upd_l, c, 0
-                    )
-                else:
-                    obs_v[b] = obs_v[b].at[c:c + k_s].set(out_vectors[idx_s])
-                    obs_l[b] = obs_l[b].at[c:c + k_s].set(upd_l)
-                counts[b] = c + k_s
-
-            if incumbent_only:
-                # only the winner leaves the device loop: reduce the final
-                # (largest-budget) stage to its best row and fold it into
-                # the running cross-bracket incumbent — crashed (NaN) rows
-                # rank behind every real loss via the shared crash rank
-                idx_f, loss_f = stages[-1]
-                key_f = jnp.where(jnp.isnan(loss_f), _CRASH_RANK, loss_f)
-                a = jnp.argmin(key_f)
-                cand_key = key_f[a]
-                take = cand_key < best_key
-                best_key = jnp.where(take, cand_key, best_key)
-                best_loss = jnp.where(take, loss_f[a], best_loss)
-                best_vec = jnp.where(take, out_vectors[idx_f[a]], best_vec)
-                best_bracket = jnp.where(
-                    take, jnp.asarray(b_i, jnp.int32), best_bracket
-                )
-                per_bracket.append(loss_f[a])
-            else:
-                idx_packed, loss_packed = _pack_stages(stages)
-                outputs.append(
-                    SweepBracketOutput(
-                        out_vectors[:n0], mb_mask, idx_packed, loss_packed
-                    )
-                )
-        result = (
-            SweepIncumbent(
-                best_vec, best_loss, best_bracket, jnp.stack(per_bracket)
-            )
-            if incumbent_only else outputs
+        stages = fused_sh_bracket(
+            eval_fn, eval_vectors, plan.num_configs, plan.budgets,
+            rank_fn=rank_fn,
+            # per-stage sharding constraints: the rung ladder's
+            # survivor batches stay distributed over the config axis
+            # (promotion masks reduce across shards on-device)
+            mesh=mesh if shard_sampling else None, axis=axis,
         )
+
+        for (idx_s, losses_s), k_s, budget in zip(
+            stages, plan.num_configs, plan.budgets
+        ):
+            b = float(budget)
+            c = counts[b]
+            upd_l = jnp.where(jnp.isnan(losses_s), jnp.inf, losses_s)
+            if dynamic_counts:
+                obs_v[b] = jax.lax.dynamic_update_slice_in_dim(
+                    obs_v[b], out_vectors[idx_s], c, 0
+                )
+                obs_l[b] = jax.lax.dynamic_update_slice_in_dim(
+                    obs_l[b], upd_l, c, 0
+                )
+            else:
+                obs_v[b] = obs_v[b].at[c:c + k_s].set(out_vectors[idx_s])
+                obs_l[b] = obs_l[b].at[c:c + k_s].set(upd_l)
+            counts[b] = c + k_s
+
+        out = None
+        if incumbent_only:
+            # only the winner leaves the device loop: reduce the final
+            # (largest-budget) stage to its best row and fold it into
+            # the running cross-bracket incumbent — crashed (NaN) rows
+            # rank behind every real loss via the shared crash rank
+            best_key, best_loss, best_vec, best_bracket, per_bracket = inc
+            idx_f, loss_f = stages[-1]
+            key_f = jnp.where(jnp.isnan(loss_f), _CRASH_RANK, loss_f)
+            a = jnp.argmin(key_f)
+            cand_key = key_f[a]
+            take = cand_key < best_key
+            best_key = jnp.where(take, cand_key, best_key)
+            best_loss = jnp.where(take, loss_f[a], best_loss)
+            best_vec = jnp.where(take, out_vectors[idx_f[a]], best_vec)
+            best_bracket = jnp.where(
+                take, jnp.asarray(b_i, jnp.int32), best_bracket
+            )
+            per_bracket = per_bracket.at[b_i].set(loss_f[a])
+            inc = (best_key, best_loss, best_vec, best_bracket, per_bracket)
+        else:
+            idx_packed, loss_packed = _pack_stages(stages)
+            out = SweepBracketOutput(
+                out_vectors[:n0], mb_mask, idx_packed, loss_packed
+            )
+        return obs_v, obs_l, counts, inc, out
+
+    def sweep(
+        seed: jax.Array, warm_v=None, warm_l=None, warm_n=None
+    ) -> List[SweepBracketOutput]:
+        key = jax.random.key(seed)
+        obs_v, obs_l, counts = init_obs_state(warm_v, warm_l, warm_n)
+        inc = init_incumbent() if incumbent_only else None
+        outputs: List[SweepBracketOutput] = []
+        if resident:
+            # the resident outer loop: ONE traced round of the bracket
+            # rotation, scanned over rounds — bracket rotation, KDE
+            # refit, promotion and the incumbent update never surface to
+            # host between brackets, and program size is O(rotation)
+            # instead of O(brackets)
+            def round_body(carry, r):
+                obs_v, obs_l, counts, inc = carry
+                outs = []
+                for pos, plan in enumerate(round_plans):
+                    obs_v, obs_l, counts, inc, out = run_bracket(
+                        r * rotation + pos, plan, key,
+                        obs_v, obs_l, counts, inc,
+                    )
+                    if not incumbent_only:
+                        outs.append(out)
+                if pin_state_shards:
+                    # the scan carry is an AOT-stable boundary like the
+                    # return_state one: in/out shardings must agree by
+                    # construction, not by XLA's whim
+                    obs_v = {b: shard_rows(v, mesh, axis)
+                             for b, v in obs_v.items()}
+                    obs_l = {b: shard_rows(l, mesh, axis)
+                             for b, l in obs_l.items()}
+                return (obs_v, obs_l, counts, inc), tuple(outs)
+
+            (obs_v, obs_l, counts, inc), stacked = jax.lax.scan(
+                round_body, (obs_v, obs_l, counts, inc),
+                jnp.arange(n_rounds, dtype=jnp.int32),
+            )
+            tail_outs: List[SweepBracketOutput] = []
+            for j, plan in enumerate(tail_plans):
+                obs_v, obs_l, counts, inc, out = run_bracket(
+                    n_rounds * rotation + j, plan, key,
+                    obs_v, obs_l, counts, inc,
+                )
+                if not incumbent_only:
+                    tail_outs.append(out)
+            result = (
+                SweepIncumbent(inc[2], inc[1], inc[3], inc[4])
+                if incumbent_only
+                else ResidentSweepOutputs(stacked, tuple(tail_outs))
+            )
+        else:
+            for b_i, plan in enumerate(plans):
+                obs_v, obs_l, counts, inc, out = run_bracket(
+                    b_i, plan, key, obs_v, obs_l, counts, inc
+                )
+                if not incumbent_only:
+                    outputs.append(out)
+            result = (
+                SweepIncumbent(inc[2], inc[1], inc[3], inc[4])
+                if incumbent_only else outputs
+            )
         if return_state:
             # the donated warm inputs alias these outputs buffer-for-buffer
             # (same pytree structure, shapes, dtypes) — the in-place state
@@ -1144,7 +1325,12 @@ def make_fused_sweep_fn(
 
         rep = NamedSharding(mesh, PartitionSpec())
         return tracked_jit(
-            sweep, name="fused_sweep_spmd", in_shardings=rep,
-            out_shardings=rep, donate_argnums=donate,
+            sweep,
+            name="fused_sweep_resident_spmd" if resident else "fused_sweep_spmd",
+            in_shardings=rep, out_shardings=rep, donate_argnums=donate,
         )
-    return tracked_jit(sweep, name="fused_sweep", donate_argnums=donate)
+    return tracked_jit(
+        sweep,
+        name="fused_sweep_resident" if resident else "fused_sweep",
+        donate_argnums=donate,
+    )

@@ -40,6 +40,7 @@ from hpbandster_tpu.ops.sweep import (
     build_space_codec,
     make_fused_sweep_fn,
     plan_additions,
+    pow2_capacities,
 )
 from hpbandster_tpu.space import ConfigurationSpace
 from hpbandster_tpu.utils.lru import LRUCache
@@ -285,14 +286,23 @@ class FusedBOHB:
             iteration, self.min_budget, self.max_budget, self.eta
         )
 
-    def _sweep_key(self, plans, dynamic=False, caps=None):
+    def _sweep_key(self, plans, dynamic=False, caps=None, resident=False,
+                   incumbent_only=False):
         if dynamic:
+            from hpbandster_tpu.ops.kde import _pallas_fit_requested
+
             # the whole point of the dynamic tier: observation counts are
             # traced inputs, so they must NOT key the executable — only the
             # buffer capacities (shapes) do. "state" marks the
             # return_state/donated executable this driver always builds
             # (a plain dynamic sweep built elsewhere must not collide).
-            obs_term = ("dynamic", "state", tuple(sorted(caps.items())))
+            # The resolved HPB_PALLAS_KDE_FIT flag keys too: it is read
+            # at trace time inside fit_kde_pair_masked, so flipping it
+            # mid-process must MISS the cache, not silently serve an
+            # executable compiled under the other fit path.
+            obs_term = ("dynamic", "state", tuple(sorted(caps.items())),
+                        bool(resident), bool(incumbent_only),
+                        _pallas_fit_requested())
         else:
             warm_counts = {b: len(l) for b, l in self._warm_l.items()}
             obs_term = tuple(sorted(warm_counts.items()))
@@ -316,7 +326,8 @@ class FusedBOHB:
             self._forbiddens_sig,
         )
 
-    def _build_sweep_fn(self, plans, dynamic=False, caps=None):
+    def _build_sweep_fn(self, plans, dynamic=False, caps=None,
+                        resident=False, incumbent_only=False):
         warm_counts = {b: len(l) for b, l in self._warm_l.items()}
         return make_fused_sweep_fn(
             self.eval_fn,
@@ -342,10 +353,13 @@ class FusedBOHB:
             # the dynamic tier returns (and the warm inputs donate into)
             # the updated observation state, so consecutive chunks thread
             # it device-to-device instead of re-uploading warm buffers
-            return_state=dynamic,
+            return_state=dynamic and not incumbent_only,
+            resident=resident,
+            incumbent_only=incumbent_only,
         )
 
-    def _sweep_compiled(self, plans, example_args, dynamic=False, caps=None):
+    def _sweep_compiled(self, plans, example_args, dynamic=False, caps=None,
+                        resident=False, incumbent_only=False):
         """AOT-compiled sweep executable + honest timing attribution:
         returns ``(compiled, build_compile_seconds, cache_hit)``. Ahead-of-
         time ``lower().compile()`` separates compile from execute time (the
@@ -353,12 +367,16 @@ class FusedBOHB:
         on repeated runs of the same schedule. ``build_compile_seconds`` is
         the time THIS call paid — 0.0 on a cache hit, so summing it across
         artifacts never double-counts a compile."""
-        key = self._sweep_key(plans, dynamic=dynamic, caps=caps)
+        key = self._sweep_key(plans, dynamic=dynamic, caps=caps,
+                              resident=resident,
+                              incumbent_only=incumbent_only)
         hit = _SWEEP_EXE_CACHE.get(key)
         if hit is not None:
             return hit, 0.0, True
         t0 = time.perf_counter()
-        fn = self._build_sweep_fn(plans, dynamic=dynamic, caps=caps)
+        fn = self._build_sweep_fn(plans, dynamic=dynamic, caps=caps,
+                                  resident=resident,
+                                  incumbent_only=incumbent_only)
         compiled = fn.lower(*example_args).compile()
         dt = time.perf_counter() - t0
         _SWEEP_EXE_CACHE[key] = compiled
@@ -372,6 +390,7 @@ class FusedBOHB:
         chunk_brackets: Optional[int] = None,
         checkpoint_path: Optional[str] = None,
         dynamic_counts: Optional[bool] = None,
+        resident: bool = False,
     ) -> Result:
         """Run brackets as fused device computation(s).
 
@@ -419,6 +438,20 @@ class FusedBOHB:
         model-based brackets make different — equally valid — draws; the
         tiers are not bitwise twins, the same way the host trickle and
         batched tiers are not.
+
+        ``resident=True`` compiles the schedule as ONE resident program:
+        the HyperBand rotation's repeating round traces once and a
+        ``lax.scan`` drives it over rounds (``ops/sweep.py``
+        ``resident=True``), so bracket rotation, KDE refit and promotion
+        never surface to host between brackets and program size is
+        O(rotation) instead of O(brackets). One dispatch, one fetch —
+        the bookkeeping replay and the final Result are identical to the
+        unrolled dynamic tier on the same seed (bit-parity pinned in
+        ``tests/test_resident.py``). Incompatible with
+        ``chunk_brackets`` (it replaces chunking) and with
+        ``dynamic_counts=False``. For the incumbent-only variant whose
+        host traffic is one seed up + one incumbent down, see
+        :meth:`run_incumbent`.
         """
         del min_n_workers  # API symmetry with Master.run; no worker pool here
         import jax
@@ -433,6 +466,16 @@ class FusedBOHB:
         from hpbandster_tpu.parallel.mesh import is_multiprocess_mesh
 
         multiprocess = is_multiprocess_mesh(self.mesh)
+        if resident and chunk_brackets is not None:
+            raise ValueError(
+                "resident=True replaces chunking (the whole schedule is one "
+                "scanned program) — drop chunk_brackets"
+            )
+        if resident and dynamic_counts is False:
+            raise ValueError(
+                "resident=True requires the dynamic-count tier (observation "
+                "counts are scan carry) — drop dynamic_counts=False"
+            )
         chunk = len(plans) if chunk_brackets is None else max(int(chunk_brackets), 1)
         # dynamic-count policy: chunked mode IS the compile-reuse tier. The
         # choice must not peek at the remaining schedule length — a run
@@ -440,10 +483,15 @@ class FusedBOHB:
         # execute bit-identical first chunks for the checkpoint resume
         # guarantee to hold, so only the caller-visible chunking knob (and
         # nothing derived from how many brackets remain) may select the tier
-        dynamic = (
+        dynamic = resident or (
             (chunk_brackets is not None)
             if dynamic_counts is None else bool(dynamic_counts)
         )
+        link0 = None
+        if plans:
+            from hpbandster_tpu.obs.runtime import transfer_counters
+
+            link0 = transfer_counters()
         d = int(self.codec.kind.shape[0])
         done = first
         #: deferred host bookkeeping of the PREVIOUS chunk: replaying the
@@ -506,10 +554,7 @@ class FusedBOHB:
                     }
                     for b, k in plan_additions(chunk_plans).items():
                         run_caps[b] = run_caps.get(b, 0) + k
-                    run_caps = {
-                        b: 1 << max(int(n) - 1, 255).bit_length()
-                        for b, n in run_caps.items()
-                    }
+                    run_caps = pow2_capacities(run_caps)
                     if dev_state is not None and run_caps == dev_caps:
                         # same buffer shapes: hand the previous chunk's
                         # device state straight back — zero warm-state
@@ -582,7 +627,8 @@ class FusedBOHB:
                 note_transfer("h2d", upload_bytes)
                 with trace(profile_dir):
                     compiled, compile_s, cache_hit = self._sweep_compiled(
-                        tuple(chunk_plans), args, dynamic=dynamic, caps=run_caps
+                        tuple(chunk_plans), args, dynamic=dynamic,
+                        caps=run_caps, resident=resident,
                     )
                     t_exec = time.perf_counter()
                     raw = compiled(*args)  # async dispatch
@@ -601,11 +647,21 @@ class FusedBOHB:
                     execute_s = time.perf_counter() - t_exec
                     if dynamic:
                         dev_state, dev_caps = new_state, run_caps
-                note_transfer(
-                    "d2h",
-                    sum(int(l.nbytes)
-                        for l in jax.tree_util.tree_leaves(outputs)),
+                d2h_bytes = sum(
+                    int(l.nbytes)
+                    for l in jax.tree_util.tree_leaves(outputs)
                 )
+                note_transfer("d2h", d2h_bytes)
+                if resident:
+                    # scan-stacked per-rotation-position outputs -> the
+                    # flat per-bracket list the replay below consumes
+                    from hpbandster_tpu.ops.sweep import (
+                        resident_rotation,
+                        unstack_resident_outputs,
+                    )
+
+                    _, n_rounds, _ = resident_rotation(chunk_plans)
+                    outputs = unstack_resident_outputs(outputs, n_rounds)
             finally:
                 # any failure above (arg building, a bucket-doubling
                 # recompile, dispatch, fetch) must still land the COMPLETED
@@ -647,7 +703,8 @@ class FusedBOHB:
                 stat["replay_overlap_s"] = round(overlap_s, 4)
             self.run_stats.append(stat)
             # one span-shaped event per device chunk: the journal's view of
-            # the fused tier (duration = dispatch -> fetch; compile split out)
+            # the fused tier (duration = dispatch -> fetch; compile split
+            # out; h2d/d2h byte fields feed the summarize host-link section)
             obs.emit(
                 "sweep_chunk",
                 duration_s=stat["execute_fetch_s"],
@@ -655,6 +712,8 @@ class FusedBOHB:
                 compile_cache_hit=cache_hit,
                 evaluations=stat["evaluations"],
                 brackets=stat["brackets"],
+                h2d_bytes=int(upload_bytes),
+                d2h_bytes=int(d2h_bytes),
             )
             # per-job device-timing attribution (VERDICT r1 #10): every run
             # of this chunk carries the chunk's compile/execute seconds into
@@ -697,10 +756,128 @@ class FusedBOHB:
                 pending_replay = replay_now
         if pending_replay is not None:
             pending_replay()  # last chunk has no successor to hide behind
+        if link0 is not None:
+            # per-sweep host-link gauges (sweep.transfer_bytes.{h2d,d2h},
+            # sweep.host_syncs): this run() call's whole transfer bill
+            from hpbandster_tpu.obs.runtime import publish_sweep_transfers
+
+            publish_sweep_transfers(link0)
         self._write_timings_sidecar()
         return Result(
             list(self.iterations) + self.warmstart_iteration, self.config
         )
+
+    def run_incumbent(
+        self,
+        n_iterations: int = 1,
+        profile_dir: Optional[str] = None,
+        resident: bool = True,
+    ) -> Dict[str, Any]:
+        """Incumbent-only (resident) sweep: the whole multi-bracket
+        schedule as one device program whose only host traffic is one
+        uint32 seed (plus any warm observations) up and one
+        :class:`~hpbandster_tpu.ops.sweep.SweepIncumbent` down — one
+        vector + one scalar + per-bracket bests, whatever the config
+        count. This is the ROADMAP "in-trace everything" mode: per-rung
+        promotion decisions never leave the device, so there is NO
+        per-config Result bookkeeping; instead the payload is journaled
+        as a ``sweep_incumbent`` audit record (``obs replay`` re-scores
+        it) with the sweep's measured h2d/d2h byte bill attached, and the
+        per-sweep transfer gauges are published. Does not advance
+        :attr:`iterations` — it is a one-shot query, not a resumable run.
+
+        Returns a stats dict: ``incumbent`` (vector/loss/bracket/
+        per-bracket bests), ``evaluations``, compile/execute seconds and
+        the ``transfers`` delta dict.
+        """
+        import jax
+
+        from hpbandster_tpu.obs.runtime import (
+            note_transfer,
+            publish_sweep_transfers,
+            transfer_counters,
+        )
+        from hpbandster_tpu.parallel.mesh import is_multiprocess_mesh
+        from hpbandster_tpu.utils.profiling import trace
+
+        if is_multiprocess_mesh(self.mesh):
+            raise ValueError(
+                "run_incumbent drives single-process meshes; use "
+                "parallel.multihost.run_sharded_fused_sweep(resident=True) "
+                "for the SPMD pod tier"
+            )
+        plans = [self._plan(i) for i in range(int(n_iterations))]
+        if not plans:
+            raise ValueError("run_incumbent needs n_iterations >= 1")
+        d = int(self.codec.kind.shape[0])
+        # same capacity policy as the chunked tier (pow2, floor 256) so a
+        # warm-started incumbent query shares executables with runs that
+        # agree on history
+        run_caps = {float(b): len(l) for b, l in self._warm_l.items()}
+        for b, k in plan_additions(plans).items():
+            run_caps[b] = run_caps.get(b, 0) + k
+        run_caps = pow2_capacities(run_caps)
+        seed = np.uint32(self.rng.integers(2**32, dtype=np.uint32))
+        warm_v_pad, warm_l_pad, warm_n = {}, {}, {}
+        for b, cap in run_caps.items():
+            v = self._warm_v.get(b)
+            n = 0 if v is None else len(v)
+            buf_v = np.zeros((cap, d), np.float32)
+            buf_l = np.full(cap, np.inf, np.float32)
+            if n:
+                buf_v[:n] = v
+                buf_l[:n] = self._warm_l[b]
+            warm_v_pad[b] = buf_v
+            warm_l_pad[b] = buf_l
+            warm_n[b] = np.int32(n)
+        args = (seed, warm_v_pad, warm_l_pad, warm_n)
+        link0 = transfer_counters()
+        upload_bytes = sum(
+            int(getattr(l, "nbytes", 0))
+            for l in jax.tree_util.tree_leaves(args)
+        )
+        note_transfer("h2d", upload_bytes)
+        with trace(profile_dir):
+            compiled, compile_s, cache_hit = self._sweep_compiled(
+                tuple(plans), args, dynamic=True, caps=run_caps,
+                resident=resident, incumbent_only=True,
+            )
+            t0 = time.perf_counter()
+            inc = jax.device_get(compiled(*args))
+            execute_s = time.perf_counter() - t0
+        note_transfer(
+            "d2h",
+            sum(int(np.asarray(l).nbytes) for l in inc), buffers=len(inc),
+        )
+        link = publish_sweep_transfers(link0)
+        evaluations = int(sum(sum(p.num_configs) for p in plans))
+        vector = [float(x) for x in np.asarray(inc.vector)]
+        loss = float(np.asarray(inc.loss))
+        bracket = int(np.asarray(inc.bracket))
+        per_bracket = [float(x) for x in np.asarray(inc.per_bracket_loss)]
+        obs.emit_sweep_incumbent(
+            vector=vector,
+            loss=loss,
+            bracket=bracket,
+            per_bracket_loss=per_bracket,
+            evaluations=evaluations,
+            d2h_bytes=link["transfer_bytes_d2h"],
+            h2d_bytes=link["transfer_bytes_h2d"],
+            host_syncs=link["transfers_h2d"] + link["transfers_d2h"],
+        )
+        return {
+            "incumbent": {
+                "vector": vector,
+                "loss": loss,
+                "bracket": bracket,
+                "per_bracket_loss": per_bracket,
+            },
+            "evaluations": evaluations,
+            "build_compile_s": round(compile_s, 4),
+            "compile_cache_hit": cache_hit,
+            "execute_fetch_s": round(execute_s, 4),
+            "transfers": link,
+        }
 
     def _can_stream_warm(self, multiprocess: bool, run_caps) -> bool:
         """Streamed per-shard warm uploads apply on single-process meshes

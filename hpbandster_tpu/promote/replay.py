@@ -190,13 +190,71 @@ def _hindsight(
     )
 
 
+def _incumbent_rows(
+    records: List[Dict[str, Any]],
+    lineages: Dict[Tuple[int, ...], Dict[str, Any]],
+) -> List[Dict[str, Any]]:
+    """Re-score ``sweep_incumbent`` records — the ONLY decision payload a
+    resident incumbent-only sweep journals (per-rung decisions never left
+    the device). Each row judges the recorded winner against the
+    per-bracket bests the same payload carried (``rank1_regret`` must be
+    ~0: the device incumbent fold IS min over bracket bests — a nonzero
+    value flags a corrupted journal or a broken kernel) and, when the
+    journal also holds evaluated results (hybrid runs), against the best
+    evaluated loss."""
+    rows: List[Dict[str, Any]] = []
+    evaluated = [
+        v
+        for lineage in lineages.values()
+        for v in lineage["results"].values()
+        if _finite(v) is not None
+    ]
+    best_evaluated = min(evaluated) if evaluated else None
+    for rec in records:
+        if rec.get("event") != E.SWEEP_INCUMBENT:
+            continue
+        loss = _finite(rec.get("loss"))
+        pb = [_finite(x) for x in rec.get("per_bracket_loss") or []]
+        finite = [x for x in pb if x is not None]
+        best = min(finite) if finite else None
+        regret = (
+            round(loss - best, 6)
+            if loss is not None and best is not None else None
+        )
+        rows.append({
+            "bracket": rec.get("bracket"),
+            "loss": loss,
+            "n_brackets": len(pb),
+            "best_bracket": (
+                pb.index(best) if best is not None else None
+            ),
+            "best_bracket_loss": best,
+            "rank1_regret": regret,
+            "consistent": (
+                None if regret is None else bool(abs(regret) < 1e-6)
+            ),
+            "vs_evaluated": (
+                round(loss - best_evaluated, 6)
+                if loss is not None and best_evaluated is not None
+                else None
+            ),
+            "d2h_bytes": rec.get("d2h_bytes"),
+            "host_syncs": rec.get("host_syncs"),
+        })
+    return rows
+
+
 def replay_records(
     records: List[Dict[str, Any]],
     rule: str,
     eta: Optional[float] = None,
 ) -> Dict[str, Any]:
     """Re-score every ``promotion_decision`` in ``records`` under
-    ``rule``; returns the deterministic replay report dict."""
+    ``rule``; returns the deterministic replay report dict. Journals
+    whose sweeps ran resident/incumbent-only carry no per-rung records —
+    their ``sweep_incumbent`` payloads are re-scored into the
+    ``incumbent`` section instead, so regret scoring still works when
+    the decisions never left the device."""
     lineages = config_lineage(records)
     budgets = [
         b
@@ -273,10 +331,17 @@ def replay_records(
         r["inversion_delta"] for r in rows
         if r["inversion_delta"] is not None
     ]
+    incumbents = _incumbent_rows(records, lineages)
     return {
         "rule": rule,
         "eta": eta,
         "decisions": rows,
+        "incumbent": {
+            "sweeps": incumbents,
+            "inconsistent": sum(
+                1 for r in incumbents if r["consistent"] is False
+            ),
+        } if incumbents else None,
         "aggregate": {
             "decisions": len(rows),
             "decisions_changed": sum(
@@ -331,6 +396,25 @@ def format_replay(rep: Dict[str, Any]) -> str:
         )
     if not rep["decisions"]:
         lines.append("  (no promotion_decision records in this journal)")
+    inc = rep.get("incumbent")
+    if inc:
+        lines.append("")
+        lines.append(
+            f"  resident incumbent payload(s): {len(inc['sweeps'])} "
+            f"sweep(s), {inc['inconsistent']} inconsistent"
+        )
+        lines.append(
+            f"  {'bracket':>8} {'loss':>12} {'best_br':>8} "
+            f"{'regret':>10} {'ok':>4} {'vs_eval':>10} {'d2h_B':>8}"
+        )
+        for r in inc["sweeps"]:
+            lines.append(
+                f"  {_fmt(r['bracket']):>8} {_fmt(r['loss']):>12} "
+                f"{_fmt(r['best_bracket']):>8} "
+                f"{_fmt(r['rank1_regret']):>10} "
+                f"{_fmt(r['consistent']):>4} {_fmt(r['vs_evaluated']):>10} "
+                f"{_fmt(r['d2h_bytes']):>8}"
+            )
     lines.append("")
     return "\n".join(lines)
 

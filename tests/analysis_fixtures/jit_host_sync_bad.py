@@ -43,3 +43,82 @@ def item_leak(x):
 def device_get_leak(x):
     pulled = jax.device_get(x)  # BAD
     return pulled
+
+
+# ---- in-trace outer-loop bodies: lax.scan/while_loop/fori_loop/cond
+# function arguments are traced exactly like jit-decorated functions
+
+
+def scan_body_casts(carry, x):
+    s = carry + x
+    return s, float(s)  # BAD
+
+
+jax.lax.scan(scan_body_casts, jnp.float32(0.0), jnp.arange(3.0))
+
+
+def while_cond_items(state):
+    return state.item()  # BAD
+
+
+def while_body_branches(state):
+    if state:  # BAD
+        return state
+    return state
+
+
+jax.lax.while_loop(while_cond_items, while_body_branches, jnp.bool_(True))
+
+
+def fori_body_numpy_sink(i, acc):
+    host = np.asarray(acc)  # BAD
+    return acc + host
+
+
+jax.lax.fori_loop(0, 3, fori_body_numpy_sink, jnp.float32(0.0))
+
+
+from jax.lax import scan  # the from-import spelling must be caught too
+
+
+def scan_body_from_import(carry, x):
+    return carry, carry.tolist()  # BAD
+
+
+scan(scan_body_from_import, jnp.float32(0.0), jnp.arange(3.0))
+
+
+# ---- implicit __bool__ forms beyond `if`/`while`
+
+
+@jax.jit
+def implicit_bool_ternary(x):
+    s = jnp.sum(x)
+    return 1.0 if s else 0.0  # BAD
+
+
+@jax.jit
+def implicit_bool_and_or(x):
+    s = jnp.sum(x)
+    picked = s and 1.0  # BAD
+    return picked
+
+
+@jax.jit
+def implicit_bool_assert(x):
+    s = jnp.sum(x)
+    assert s  # BAD
+    return s
+
+
+# ---- casts on traced EXPRESSIONS (not just bare names)
+
+
+@jax.jit
+def cast_on_subscript(x):
+    return float(x[0])  # BAD
+
+
+@jax.jit
+def cast_on_reduction(x):
+    return int(x.sum())  # BAD

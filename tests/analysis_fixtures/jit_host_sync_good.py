@@ -39,6 +39,42 @@ def host_side_helper(rows):
 
 
 @jax.jit
+def static_metadata_is_concrete(x):
+    # shape/len/ndim on a tracer are trace-time METADATA, not device
+    # values — casting them is legal static-shape arithmetic
+    rows = float(x.shape[0])
+    n = float(len(x))
+    return x * rows * n
+
+
+def scan_body_clean(carry, x):
+    # an in-trace outer-loop body with only traced-legal ops: jnp.where
+    # instead of Python branches, no casts on traced values
+    total = carry + x
+    return total, jnp.where(total > 0, total, -total)
+
+
+jax.lax.scan(scan_body_clean, jnp.float32(0.0), jnp.arange(3.0))
+
+
+def fori_body_closure_bool(i, acc):
+    # and/or over CLOSURE values (not tracers) is plain host logic
+    use_fast = bool(BUDGETS) and len(BUDGETS) > 1
+    return acc * (2.0 if use_fast else 1.0)
+
+
+jax.lax.fori_loop(0, 3, fori_body_closure_bool, jnp.float32(0.0))
+
+
+@partial(jax.jit, static_argnames=())
+def identity_check_is_static(x, extra=None):
+    # `is None` on a tracer is Python IDENTITY — a static trace-time
+    # fact, not a __bool__ coercion (the optional-argument idiom)
+    bonus = 0.0 if extra is None else jnp.sum(extra)
+    return jnp.sum(x) + bonus
+
+
+@jax.jit
 def justified_escape(x):
     y = jnp.max(x)
     # deliberate trace-time constant fold: y is data-independent here

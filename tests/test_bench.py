@@ -260,6 +260,50 @@ class TestFusedShardedTier:
             bench.BUDGET_VERDICTS.pop("fused_100k", None)
 
 
+class TestResidentTier:
+    """ISSUE 12 acceptance: the ``resident_100k`` tier runs END TO END on
+    the forced 8-device CPU mesh, budget-gated, with the host-sync count
+    per sweep CONSTANT in config count and the d2h bill flat — the
+    resident outer loop's whole point, asserted from measured transfer
+    deltas, not prose. The KDE-fit probe rides along."""
+
+    def test_resident_tier_runs_budget_gated_flat_d2h(self):
+        import jax
+
+        assert len(jax.devices()) == 8  # the conftest-forced CPU mesh
+        errors = {}
+        out = bench._run_tier(
+            errors, "resident_100k", bench.bench_resident_sharded,
+            sizes=(1024, 4096), kde_fit_sizes=(1 << 12, 1 << 14),
+            cpu_fallback=True,
+        )
+        try:
+            assert errors == {}, errors
+            assert out is not None
+            assert out["d2h_flat"] is True
+            sizes = [row["n_configs"] for row in out["per_size"]]
+            assert sizes == [1024, 4096]
+            bills = {
+                (row["d2h_bytes"], row["h2d_bytes"], row["host_syncs"])
+                for row in out["per_size"]
+            }
+            # host-sync count per sweep constant in config count, and the
+            # whole schedule is ONE dispatch
+            assert len(bills) == 1
+            assert all(row["dispatches"] == 1 for row in out["per_size"])
+            assert out["per_size"][0]["h2d_bytes"] == 4  # one uint32 seed
+            # the KDE-fit probe measured and reported
+            assert set(out["kde_fit_s"]) == {"4096", "16384"}
+            assert all(v >= 0 for v in out["kde_fit_s"].values())
+            assert out["fit_is_wall"] in (True, False, None)
+            v = bench.BUDGET_VERDICTS["resident_100k"]
+            assert v["ok"], v
+            assert v["observed"]["transfer_mb"] < 1.0
+        finally:
+            bench.COMPILE_BY_TIER.pop("resident_100k", None)
+            bench.BUDGET_VERDICTS.pop("resident_100k", None)
+
+
 def _baseline_stub(tmp_path):
     p = tmp_path / "BASELINE.md"
     p.write_text("# header kept\n\n" + bench.BASELINE_MARK + " old)\nold table\n")
@@ -465,6 +509,17 @@ def _stub_tiers(monkeypatch, calls):
                 n_configs, "balance_skew": 0.0, "scaling_efficiency": 0.9,
                 "near_linear": True, "per_device_configs": [10, 10]}
     monkeypatch.setattr(bench, "bench_fused_sharded", fused_sharded)
+
+    def resident_sharded(sizes=(1 << 13, 1 << 17), cpu_fallback=True, **kw):
+        calls.setdefault("resident_sharded", []).append(
+            {"sizes": tuple(sizes), "cpu_fallback": cpu_fallback}
+        )
+        return {"d2h_flat": True, "host_syncs_per_sweep": 5,
+                "per_size": [{"n_configs": s, "d2h_bytes": 32,
+                              "h2d_bytes": 4, "host_syncs": 5}
+                             for s in sizes],
+                "kde_fit_s": {"16384": 0.01}, "fit_is_wall": False}
+    monkeypatch.setattr(bench, "bench_resident_sharded", resident_sharded)
     monkeypatch.setattr(bench, "bench_cnn_wide", lambda **kw: {})
     monkeypatch.setattr(bench, "bench_resnet", lambda **kw: {})
     monkeypatch.setattr(bench, "bench_transformer", lambda **kw: {})
@@ -543,6 +598,12 @@ class TestFallbackContract:
         assert calls["fused_sharded"] == [
             {"n_configs": 1 << 17, "repeats": 3}
         ]
+        # the resident tier measures on the fallback too, fallback-labeled
+        # (its 1M rung joins only off the fallback path)
+        assert calls["resident_sharded"] == [
+            {"sizes": (1 << 13, 1 << 17), "cpu_fallback": True}
+        ]
+        assert d["resident_100k_scan_fused"]["d2h_flat"] is True
         # cheap informative tiers still measured; the error rides along —
         # and every measured tier dict is stamped with the platform it
         # actually ran on (the stale-budget self-description)
@@ -573,6 +634,10 @@ class TestFallbackContract:
             {"n_configs": 1 << 20, "repeats": 5},
             {"n_configs": 1 << 17, "repeats": 5},
         ]
+        # healthy backend: the resident tier's 1M rung joins the ladder
+        assert calls["resident_sharded"] == [
+            {"sizes": (1 << 13, 1 << 17), "cpu_fallback": False}
+        ]
         d = r["detail"]
         assert d["fused_1M_mesh_sharded"]["near_linear"] is True
         assert d["fused_1M_mesh_sharded"]["cpu_fallback"] is False
@@ -597,6 +662,8 @@ class TestTierSelection:
         assert "skipped" in d["tiers"]["rpc_pool_1worker"]
         assert "skipped" in d["fused_1M_mesh_sharded"]
         assert "skipped" in d["fused_100k_mesh_sharded"]
+        assert "skipped" in d["resident_100k_scan_fused"]
+        assert "resident_sharded" not in calls
         # deselected tiers are never stamped (they did not run anywhere)
         assert "platform" not in d["fused_100k_mesh_sharded"]
         assert d["cnn_workload_budget_sgd_steps"]["platform"] == "cpu"
@@ -683,10 +750,11 @@ class TestTierSelection:
         # the --tiers vocabulary and the execution order are one constant
         assert set(bench.TIER_ORDER) == {
             "cnn", "cnn_wide", "pallas", "resnet", "transformer",
-            "fused_1M", "fused_100k", "fused10k", "chunked10k",
-            "chunked_compile", "fused", "rpc", "batched", "teacher",
-            "multitenant", "chaos", "async_straggler", "obs_overhead",
-            "runtime_overhead", "collector_overhead", "report_100k",
+            "fused_1M", "fused_100k", "resident_100k", "fused10k",
+            "chunked10k", "chunked_compile", "fused", "rpc", "batched",
+            "teacher", "multitenant", "chaos", "async_straggler",
+            "obs_overhead", "runtime_overhead", "collector_overhead",
+            "report_100k",
         }
 
 
