@@ -327,19 +327,16 @@ class BaseIteration:
         """bool[n] promotion mask — implemented by subclasses."""
         raise NotImplementedError
 
-    def measured_cost(
+    def reported_cost(
         self, config_id: ConfigId, budget: float
     ) -> Optional[float]:
-        """Measured evaluation cost (seconds) of one config at one rung,
-        or None when unmeasured.
-
-        Priority: an explicit ``cost`` the evaluation reported in its
-        info payload (a worker measuring device time, not wall), then the
-        started->finished wall span the job's timestamp schema already
-        records. This is the cost column multi-objective promotion ranks
-        (promote/pareto.py) and what rides ``promotion_decision.costs``
-        so a recorded journal stays Pareto-replayable.
-        """
+        """The explicit ``cost`` an evaluation reported in its info
+        payload (a worker measuring device seconds, not wall) — the only
+        genuinely PER-CANDIDATE cost measurement; None when the
+        evaluation reported none. Split out of :meth:`measured_cost` so
+        cost-aware promotion (promote/pareto.py) can prefer a reported
+        measurement, then an obs-histogram aggregate, and fall back to
+        the wall span only when neither exists."""
         d = self.data.get(config_id)
         if d is None:
             return None
@@ -348,12 +345,41 @@ class BaseIteration:
             cost = info.get("cost")
             if isinstance(cost, (int, float)) and np.isfinite(cost):
                 return float(cost)
+        return None
+
+    def wall_span_cost(
+        self, config_id: ConfigId, budget: float
+    ) -> Optional[float]:
+        """The started->finished wall span the job's timestamp schema
+        records — the noisiest cost estimate (queue/dispatch jitter
+        included), kept as the last-resort fallback."""
+        d = self.data.get(config_id)
+        if d is None:
+            return None
         ts = d.time_stamps.get(budget) or {}
         try:
             span = float(ts["finished"]) - float(ts["started"])
         except (KeyError, TypeError, ValueError):
             return None
         return span if np.isfinite(span) and span >= 0 else None
+
+    def measured_cost(
+        self, config_id: ConfigId, budget: float
+    ) -> Optional[float]:
+        """Measured evaluation cost (seconds) of one config at one rung,
+        or None when unmeasured.
+
+        Priority: an explicit ``cost`` the evaluation reported in its
+        info payload (:meth:`reported_cost`), then the started->finished
+        wall span (:meth:`wall_span_cost`). This is the cost column
+        multi-objective promotion ranks (promote/pareto.py) and what
+        rides ``promotion_decision.costs`` so a recorded journal stays
+        Pareto-replayable.
+        """
+        cost = self.reported_cost(config_id, budget)
+        if cost is not None:
+            return cost
+        return self.wall_span_cost(config_id, budget)
 
     def promotion_cost(
         self, config_id: ConfigId, budget: float

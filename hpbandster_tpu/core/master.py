@@ -264,6 +264,17 @@ class Master:
             # feeds the obs_snapshot `latency` section: evaluation-time
             # quantiles visible over RPC with no journal on disk
             obs.get_metrics().histogram("master.job_run_s").observe(run_s)
+            # ... and the budget-keyed twin: the per-budget evaluation
+            # cost aggregate multi-objective promotion ranks by
+            # (obs.budget_cost_from_obs — the obs-histogram cost feed,
+            # promote/pareto.py) instead of each job's noisy wall span.
+            # Budgets are a short ladder, so the family count is bounded;
+            # export renders them as one labeled family.
+            budget = job.kwargs.get("budget")
+            if isinstance(budget, (int, float)):
+                obs.get_metrics().histogram(
+                    f"master.job_run_s.b{float(budget):g}"
+                ).observe(run_s)
         # the tenant wrap covers the bracket bookkeeping too: promotion /
         # audit events emitted by process_results() carry the stamp; the
         # run wrap scopes the straggler-ledger drain (obs/audit.py) to

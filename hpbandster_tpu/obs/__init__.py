@@ -81,6 +81,13 @@ from hpbandster_tpu.obs.collector import (  # noqa: F401
     format_fleet_table,
     read_series,
 )
+from hpbandster_tpu.obs.device_metrics import (  # noqa: F401
+    budget_cost_from_obs,
+    decode_device_metrics,
+    device_metrics_default,
+    emit_device_telemetry,
+    publish_device_metrics,
+)
 from hpbandster_tpu.obs.audit import (  # noqa: F401
     AUDIT_EVENTS,
     AUDIT_RULE_FIELDS,
@@ -99,6 +106,7 @@ from hpbandster_tpu.obs.events import (  # noqa: F401
     CHAOS_FAULT,
     CHECKPOINT_WRITTEN,
     CONFIG_SAMPLED,
+    DEVICE_TELEMETRY,
     DUPLICATE_RESULT,
     EVENT_TYPES,
     FLEET_SAMPLE,
@@ -197,6 +205,9 @@ __all__ = [
     "emit_config_sampled", "emit_promotion_decision",
     "emit_sweep_incumbent",
     "note_straggler", "drain_stragglers",
+    "decode_device_metrics", "publish_device_metrics",
+    "emit_device_telemetry", "budget_cost_from_obs",
+    "device_metrics_default",
     "CompileTracker", "DeviceSampler", "get_compile_tracker",
     "note_transfer", "publish_sweep_transfers", "transfer_counters",
     "runtime_snapshot", "start_device_sampler",
@@ -214,6 +225,7 @@ __all__ = [
     "FLEET_SAMPLE",
     "JOB_REQUEUED", "RESULT_REPLAYED", "DUPLICATE_RESULT",
     "WORKER_QUARANTINED", "CHAOS_FAULT", "SWEEP_INCUMBENT",
+    "DEVICE_TELEMETRY",
 ]
 
 

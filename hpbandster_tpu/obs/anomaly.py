@@ -21,7 +21,19 @@ docs/observability.md "Alert rules"):
 * **nan_burst** — ``nan_burst_threshold`` non-finite-loss / failed
   evaluations within the last ``nan_burst_window`` results. One diverged
   config is BOHB-normal (crashed-as-worst); a burst means the objective
-  or a budget rung is broken.
+  or a budget rung is broken. The rule has TWO feeds: host job events
+  (the per-result window above), and the device crash counters a
+  ``device_telemetry`` record carries (``obs/device_metrics.py``) — a
+  fused/resident sweep journals no per-job events, so its crashes fire
+  the rule through the decoded counters instead: ``crashes >=
+  nan_burst_threshold`` AND crash rate >= ``nan_burst_device_rate``
+  (an absolute count alone would false-positive at 100k configs).
+* **bracket_skew** — a ``device_telemetry`` record whose crashed
+  evaluations concentrate in a few brackets: the max per-bracket crash
+  count is at least ``bracket_skew_min_crashes`` and its skew over the
+  median ((max - median) / max) reaches ``bracket_skew``. Spread-out
+  crashes are the objective's problem (nan_burst's beat); one straggling
+  bracket means a specific budget rung or rotation slot is broken.
 * **kde_refit_stall** — ``kde_stall_results`` results ingested since the
   last ``kde_refit`` while a model exists: the optimizer has silently
   degraded to random search (e.g. every new result lands on a budget
@@ -63,6 +75,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import math
+import statistics
 import threading
 from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
@@ -101,6 +114,19 @@ class AnomalyRules:
     #: nan_burst: this many bad results within the last window results
     nan_burst_threshold: int = 5
     nan_burst_window: int = 32
+    #: ... and the device-counter feed: a device_telemetry record fires
+    #: nan_burst when its crashes reach the threshold AND this fraction
+    #: of its evaluations (rate-gated: 5 crashes in a 100k-config sweep
+    #: is healthy, 5 in 12 is not). 0 disables the device feed.
+    nan_burst_device_rate: float = 0.25
+
+    #: bracket_skew (device_telemetry records): fire when the max
+    #: per-bracket crash count reaches `bracket_skew_min_crashes` and
+    #: (max - median) / max over the per-bracket crash counts reaches
+    #: `bracket_skew` — crashes concentrated in one bracket mean a
+    #: broken budget rung, not a flaky objective. min_crashes=0 disables.
+    bracket_skew: float = 0.5
+    bracket_skew_min_crashes: int = 8
 
     #: kde_refit_stall: results since the last refit (0 disables)
     kde_stall_results: int = 64
@@ -354,6 +380,59 @@ class AnomalyDetector:
         elif name == E.KDE_REFIT:
             self._refit_seen = True
             self._results_since_refit = 0
+
+        # --- device-counter feeds: a fused/resident sweep journals ONE
+        # device_telemetry record instead of per-job events, so the
+        # result-shaped rules read its decoded crash counters directly.
+        if name == E.DEVICE_TELEMETRY:
+            crashes = rec.get("crashes")
+            evals = rec.get("evaluations")
+            if (
+                r.nan_burst_device_rate > 0
+                and isinstance(crashes, (int, float))
+                and isinstance(evals, (int, float)) and evals > 0
+                and crashes >= r.nan_burst_threshold
+                and crashes / evals >= r.nan_burst_device_rate
+            ):
+                a = self._fire(
+                    rec, "nan_burst", "device",
+                    bad_results=int(crashes),
+                    evaluations=int(evals),
+                    crash_rate=round(float(crashes) / float(evals), 4),
+                )
+                if a:
+                    fired.append(a)
+            per_bracket = rec.get("per_bracket_crashes")
+            if (
+                r.bracket_skew_min_crashes > 0
+                and isinstance(per_bracket, list) and len(per_bracket) >= 2
+                and all(
+                    isinstance(c, (int, float)) and not isinstance(c, bool)
+                    for c in per_bracket
+                )
+            ):
+                counts = [float(c) for c in per_bracket]
+                hi = max(counts)
+                # true median (statistics.median interpolates even
+                # lengths) — the upper-middle element would understate
+                # the skew for even bracket counts and silently disable
+                # the rule on symmetric crash splits
+                median = statistics.median(counts)
+                skew = 0.0 if hi <= 0 else (hi - median) / hi
+                if hi >= r.bracket_skew_min_crashes and skew >= r.bracket_skew:
+                    worst = max(
+                        range(len(per_bracket)),
+                        key=lambda i: float(per_bracket[i]),
+                    )
+                    a = self._fire(
+                        rec, "bracket_skew", f"bracket{worst}",
+                        max_crashes=int(hi),
+                        median_crashes=round(median, 1),
+                        skew=round(skew, 4),
+                        threshold=r.bracket_skew,
+                    )
+                    if a:
+                        fired.append(a)
 
         # --- recompile storm: one function's tracked_jit boundary keeps
         # compiling. Subjects key per fn (tracked_jit events carry no

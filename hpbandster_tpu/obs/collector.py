@@ -68,6 +68,7 @@ import time
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple, Union
 
 from hpbandster_tpu.obs import events as E
+from hpbandster_tpu.obs.device_metrics import device_metric_fields
 from hpbandster_tpu.obs.journal import JsonlJournal
 from hpbandster_tpu.obs.metrics import MetricsRegistry, get_metrics
 
@@ -186,6 +187,11 @@ def _endpoint_row(snap: Dict[str, Any]) -> Dict[str, Any]:
         v = _num(value)
         if dev and field and v is not None:
             sweep_devices.setdefault(dev, {})[field] = v
+    # device metrics plane (obs/device_metrics.py): the last sweep's
+    # decoded in-trace telemetry totals — what `top` renders as the
+    # device-telemetry line and watch --snapshot appends per row (ONE
+    # gauge-name parser, shared with the watch renderer)
+    device_metrics = device_metric_fields(gauges)
     return {
         "component": snap.get("component"),
         "uptime_s": _num(snap.get("uptime_s")),
@@ -199,6 +205,7 @@ def _endpoint_row(snap: Dict[str, Any]) -> Dict[str, Any]:
         "top_recompilers": _top_recompilers(compile_led),
         "devices": dev_rows,
         "sweep_devices": sweep_devices,
+        "device_metrics": device_metrics,
         "alerts_total": _num(alerts.get("total")),
         "tenants": tenants,
     }
@@ -864,6 +871,29 @@ def format_fleet_table(
                 _fmt(fleet.get("tenants")),
                 _fmt(fleet.get("tenant_throughput_ratio"), 2),
                 f"  [filter: tenant={tenant}]" if tenant else "",
+            )
+        )
+    # device-telemetry section: aggregate the per-endpoint last-sweep
+    # in-trace counters (obs/device_metrics.py) — present only when at
+    # least one endpoint published them, so telemetry-free fleets render
+    # exactly as before
+    dm_rows = [
+        row.get("device_metrics")
+        for row in (sample.get("endpoints") or {}).values()
+        if row.get("device_metrics")
+    ]
+    if dm_rows:
+        evals = sum(int(r.get("evaluations", 0)) for r in dm_rows)
+        crashes = sum(int(r.get("crashes", 0)) for r in dm_rows)
+        rounds = sum(int(r.get("rounds", 0)) for r in dm_rows)
+        fits = sum(int(r.get("model_fits", 0)) for r in dm_rows)
+        lines.append(
+            "       device_telemetry: evals={}  crashed={}{}  rounds={}  "
+            "model_fits={}".format(
+                evals, crashes,
+                " ({:.2f}%)".format(100.0 * crashes / evals)
+                if evals else "",
+                rounds, fits,
             )
         )
     lines.append("")

@@ -65,6 +65,7 @@ __all__ = [
     "WORKER_QUARANTINED",
     "CHAOS_FAULT",
     "SWEEP_INCUMBENT",
+    "DEVICE_TELEMETRY",
 ]
 
 logger = logging.getLogger("hpbandster_tpu.obs")
@@ -117,6 +118,13 @@ CHAOS_FAULT = "chaos_fault"
 #: never left the device produces (obs/audit.py emit_sweep_incumbent;
 #: `obs replay` re-scores it)
 SWEEP_INCUMBENT = "sweep_incumbent"
+#: one sweep's decoded device-metrics record (obs/device_metrics.py):
+#: per-rung log-binned loss histograms, crash/evaluation/promotion
+#: counts, KDE-refit tallies and the per-bracket incumbent trail — all
+#: accumulated IN-TRACE (ops/sweep.py DeviceMetrics) and decoded on the
+#: sweep's final d2h, so fused/resident sweeps feed the obs pipeline
+#: without surfacing per-job events
+DEVICE_TELEMETRY = "device_telemetry"
 
 #: the core vocabulary (docs/observability.md "Event schema"). emit() also
 #: accepts names outside this set — subsystems may add their own (span
@@ -127,7 +135,7 @@ EVENT_TYPES = frozenset({
     RPC_RETRY, RESULT_DELIVERED, CHECKPOINT_WRITTEN, UNKNOWN_RESULT,
     CONFIG_SAMPLED, PROMOTION_DECISION, ALERT, XLA_COMPILE, FLEET_SAMPLE,
     JOB_REQUEUED, RESULT_REPLAYED, DUPLICATE_RESULT, WORKER_QUARANTINED,
-    CHAOS_FAULT, SWEEP_INCUMBENT,
+    CHAOS_FAULT, SWEEP_INCUMBENT, DEVICE_TELEMETRY,
 })
 
 #: process-wide kill switch (hpbandster_tpu.obs.set_enabled)

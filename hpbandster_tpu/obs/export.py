@@ -92,6 +92,25 @@ _LABEL_RULES: Tuple[Tuple[re.Pattern, str, object], ...] = (
     # sweep's flat-d2h acceptance reads this gauge
     (re.compile(r"^sweep\.transfer_bytes\.(?P<label>h2d|d2h)$"),
      "sweep_transfer_bytes", "direction"),
+    # device metrics plane (obs/device_metrics.py publish_device_metrics):
+    # sweep.rung.<budget>.loss_p95 -> sweep_rung_loss_p95{budget="..."} —
+    # per-rung crash/eval/promotion counts and loss quantiles decoded
+    # from the in-trace telemetry pytree. Greedy label + dot-free field:
+    # a budget rendered with a dot (0.5) keeps it in the label, the LAST
+    # dot separates the field (the serve-tenant idiom).
+    (re.compile(
+        r"^sweep\.rung\.(?P<label>.+)\.(?P<field>[a-zA-Z0-9_]+)$",
+        re.DOTALL),
+     "sweep_rung_{field}", "budget"),
+    # per-budget evaluation-cost estimate derived from device telemetry —
+    # the gauge half of the Pareto cost feed (budget_cost_from_obs)
+    (re.compile(r"^sweep\.budget_cost_s\.(?P<label>.+)$", re.DOTALL),
+     "sweep_budget_cost_s", "budget"),
+    # the master's budget-keyed evaluation-time histograms (the histogram
+    # half of the cost feed): master.job_run_s.b<budget> histogram
+    # families label by budget instead of minting one family per budget
+    (re.compile(r"^master\.job_run_s\.b(?P<label>.+)$", re.DOTALL),
+     "master_job_run_s_budget", "budget"),
     (re.compile(r"^runtime\.compiles\.(?P<label>.+)$", re.DOTALL),
      "runtime_fn_compiles", "fn"),
     # roofline/cost families (obs/runtime.py _TrackedLowered cost

@@ -23,6 +23,11 @@ latencies, utilization, failures), ``report`` answers "how did the
 * **runtime** — compile economics from ``xla_compile`` records
   (``obs/runtime.py``): total compiles, compile seconds, their share of
   the run's wall-clock window, and the top recompiling functions;
+* **device telemetry** — the decoded in-trace metrics plane
+  (``device_telemetry`` records, ``obs/device_metrics.py``): per-rung
+  crash/promotion counts and loss quantiles for fused/resident sweeps
+  whose decisions never surfaced to host (same aggregation as
+  ``summarize`` — the two views cannot drift);
 * **alert digest** — the anomaly detector's verdicts: recorded ``alert``
   events when a live detector ran, otherwise a deterministic offline
   replay of the same rules (``obs.anomaly.scan_records``).
@@ -37,12 +42,16 @@ from __future__ import annotations
 
 import bisect
 import json
-import math
 from typing import Any, Dict, List, Optional, Tuple
 
 from hpbandster_tpu.obs import events as E
 from hpbandster_tpu.obs.anomaly import scan_records
 from hpbandster_tpu.obs.audit import config_key, config_lineage
+from hpbandster_tpu.obs.device_metrics import (
+    device_section_from_records,
+    finite_or_none as _finite,
+    format_device_section,
+)
 from hpbandster_tpu.obs.runtime import compile_stats_from_records
 from hpbandster_tpu.obs.trace import DEFAULT_TENANT
 
@@ -81,18 +90,6 @@ def _fmt(v: Any) -> str:
     if isinstance(v, float):
         return f"{v:.6g}"
     return str(v)
-
-
-def _finite(v: Any) -> Optional[float]:
-    """Finite numeric or None; bools (a corrupt record's `true` loss)
-    are not losses."""
-    if (
-        isinstance(v, (int, float))
-        and not isinstance(v, bool)
-        and math.isfinite(v)
-    ):
-        return float(v)
-    return None
 
 
 def promotion_hindsight(
@@ -458,6 +455,10 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         # of the live recompile_storm rule (one shared aggregation with
         # the summarize CLI — the two views of one journal must agree)
         "runtime": compile_stats_from_records(records, window),
+        # the device metrics plane (obs/device_metrics.py): decoded
+        # in-trace telemetry of fused/resident sweeps — shared
+        # aggregation with summarize, same drift rule as runtime
+        "device": device_section_from_records(records),
         "alerts": _alert_digest(records, t0),
     }
 
@@ -576,6 +577,10 @@ def format_report(rep: Dict[str, Any]) -> str:
             )
     else:
         lines.append("  (no xla_compile records in this journal)")
+
+    device = rep.get("device")
+    if device:
+        lines += [""] + format_device_section(device)
 
     al = rep["alerts"]
     lines += [

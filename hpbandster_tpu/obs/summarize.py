@@ -16,6 +16,10 @@ run's shape:
 * **xla runtime** — compile count / seconds from ``xla_compile`` records
   (``obs/runtime.py``), the compile-time share of the journal's
   wall-clock window, and the top recompiling functions;
+* **device telemetry** — the decoded in-trace metrics plane
+  (``device_telemetry`` records, ``obs/device_metrics.py``): crash rate,
+  per-rung loss quantiles and promotion counts for fused/resident sweeps
+  whose per-job events never surfaced to host;
 * **per-trace timelines** — records sharing a ``trace_id`` (one job's
   round-trip, see ``obs/trace.py``) joined across journals into a
   queue-wait -> dispatch -> compute -> delivery stage breakdown, with the
@@ -227,6 +231,10 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     #: host-link bill carried by sweep-level records (``sweep_chunk`` /
     #: ``sweep_incumbent`` stamp h2d_bytes/d2h_bytes/host_syncs)
     link = {"records": 0, "h2d_bytes": 0, "d2h_bytes": 0, "host_syncs": 0}
+    #: device-telemetry records (obs/device_metrics.py): the decoded
+    #: in-trace counters fused/resident sweeps journal instead of
+    #: per-job events
+    device_records: List[Dict[str, Any]] = []
 
     def worker_slot(name: str) -> Dict[str, float]:
         return workers.setdefault(
@@ -274,6 +282,8 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 v = rec.get(field)
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
                     link[field] += int(v)
+        if name == E.DEVICE_TELEMETRY:
+            device_records.append(rec)
 
     window_s = (
         (t_wall_max - t_wall_min)
@@ -306,6 +316,12 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
 
     runtime = compile_stats_from_records(records, window_s)
 
+    # same sharing rule for the device metrics plane: summarize and
+    # report both render device_section_from_records' aggregation
+    from hpbandster_tpu.obs.device_metrics import device_section_from_records
+
+    device = device_section_from_records(device_records)
+
     return {
         "events_total": sum(counts.values()),
         "window_s": round(window_s, 3),
@@ -316,6 +332,9 @@ def summarize_records(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         # device<->host byte accounting, when any sweep-level record
         # carried it (the resident tier's flat-d2h evidence in journal form)
         "host_link": link if link["records"] else None,
+        # decoded in-trace telemetry (obs/device_metrics.py) — the view
+        # of sweeps whose per-job events never surfaced to host
+        "device": device,
         "failures": {
             "jobs_failed": counts.get(E.JOB_FAILED, 0),
             "rpc_retries": counts.get(E.RPC_RETRY, 0),
@@ -382,6 +401,12 @@ def format_summary(s: Dict[str, Any]) -> str:
                 link["records"], link["host_syncs"],
             )
         )
+    device = s.get("device")
+    if device:
+        from hpbandster_tpu.obs.device_metrics import format_device_section
+
+        lines.append("")
+        lines.extend(format_device_section(device))
     lines.append("")
     f = s["failures"]
     lines.append(
@@ -629,6 +654,28 @@ def _snapshot_tenant_part(
     ) + (",..." if len(done) > 4 else "") + ")"
 
 
+def _snapshot_device_part(snap: Dict[str, Any]) -> str:
+    """The device-metrics-plane slice of one watch line: the last
+    sweep's decoded in-trace counters (``sweep.device_metrics.*``
+    gauges, obs/device_metrics.py). No telemetry, no part — lines from
+    telemetry-free processes stay exactly as they were."""
+    from hpbandster_tpu.obs.device_metrics import device_metric_fields
+
+    dm = device_metric_fields((snap.get("metrics") or {}).get("gauges"))
+    if not dm:
+        return ""
+    parts = []
+    if "evaluations" in dm:
+        parts.append(f"evals={int(dm['evaluations'])}")
+    if "crashes" in dm:
+        parts.append(f"crashed={int(dm['crashes'])}")
+    if "crash_rate" in dm:
+        parts.append(f"crash_rate={dm['crash_rate']:.4g}")
+    if "rounds" in dm:
+        parts.append(f"rounds={int(dm['rounds'])}")
+    return (" device: " + " ".join(parts)) if parts else ""
+
+
 def _snapshot_status_line(
     snap: Dict[str, Any], tenant: Optional[str] = None
 ) -> str:
@@ -651,6 +698,7 @@ def _snapshot_status_line(
         f"alerts={alerts.get('total', 0)}"
         + (f" latency: {lat_part}" if lat_part else "")
         + _snapshot_tenant_part(snap, tenant)
+        + _snapshot_device_part(snap)
         + _snapshot_runtime_part(snap)
     )
 

@@ -52,6 +52,7 @@ __all__ = [
     "BucketPlan",
     "BucketSet",
     "build_bucket_set",
+    "bucketed_stage_telemetry",
     "fused_sh_bracket_bucketed",
     "fused_sh_bracket_bucketed_packed",
     "make_bucketed_bracket_fn",
@@ -316,6 +317,37 @@ def fused_sh_bracket_bucketed_packed(
         )
 
     return jax.vmap(one_lane)(vectors, jnp.asarray(counts, jnp.int32))
+
+
+def bucketed_stage_telemetry(stages, counts, edges):
+    """Jittable device-metrics accumulation over one BUCKETED bracket's
+    stages: per-stage ``(histogram i32[n_bins], crash_count i32[])`` in
+    exactly the schema the fused-sweep accumulator emits
+    (``ops.fused.stage_telemetry`` over ``obs/device_metrics.py`` bin
+    edges) — the seam through which the bucketed/megabatch executor tier
+    joins the device metrics plane.
+
+    A bucketed stage's rows past its traced ``counts[t]`` are padding:
+    evaluated but never reported, so they are masked out of BOTH the
+    histogram and the crash count here (a padding row's garbage loss —
+    or NaN — must not read as telemetry). Output shapes are fixed by the
+    bucket depth and bin count alone.
+    """
+    import jax.numpy as jnp
+
+    from hpbandster_tpu.ops.fused import stage_telemetry
+
+    counts = jnp.asarray(counts, jnp.int32)
+    out = []
+    for t, (_idx_t, losses_t) in enumerate(stages):
+        live = jnp.arange(losses_t.shape[0], dtype=jnp.int32) < counts[t]
+        # padding rows become NaN for the histogram mask, then their
+        # (artificial) crash contribution is subtracted back out
+        masked = jnp.where(live, losses_t, jnp.nan)
+        hist, crashes = stage_telemetry(masked, edges)
+        crashes = crashes - jnp.sum(~live).astype(jnp.int32)
+        out.append((hist, crashes))
+    return out
 
 
 def slice_member_stages(

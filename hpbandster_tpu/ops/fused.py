@@ -24,7 +24,8 @@ import numpy as np
 
 from hpbandster_tpu.obs.runtime import note_transfer, tracked_jit
 
-__all__ = ["fused_sh_bracket", "make_fused_bracket_fn", "shard_rows"]
+__all__ = ["fused_sh_bracket", "make_fused_bracket_fn", "shard_rows",
+           "stage_telemetry"]
 
 #: crashed (NaN) losses map here for ranking: behind any real loss, ahead of
 #: the +inf padding rows, ties broken index-stably by top_k — the same
@@ -65,6 +66,47 @@ def shard_rows(x: jax.Array, mesh, axis: str = "config") -> jax.Array:
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, PartitionSpec(axis))
     )
+
+
+def stage_telemetry(
+    losses: jax.Array, edges
+) -> Tuple[jax.Array, jax.Array]:
+    """Jittable one-stage telemetry: ``(histogram i32[len(edges)+1],
+    crash_count i32[])`` over one rung's losses — the device half of the
+    metrics plane (``obs/device_metrics.py`` owns the schema; ``edges``
+    are its ``bin_edges()``, the ONE definition host and device bin
+    against).
+
+    NaN (crashed) losses are excluded from the histogram and counted in
+    the crash counter; +/-inf are finite-for-binning (they land in the
+    overflow/underflow bins — a diverged loss is still a loss). A loss
+    equal to a bin's upper bound lands IN that bin (<= against the upper
+    bound, matching ``obs.metrics.Histogram``'s ``bisect_left``).
+
+    Deliberately scatter-free: the histogram is a cumulative
+    ``count(loss <= edge)`` compare-matrix reduced over the loss axis,
+    then adjacent-differenced — XLA lowers it to vectorized partial sums
+    (and, when the losses are sharded over the config axis, to per-shard
+    partials + one tiny cross-shard reduction), where a scatter-add
+    lowers to a serial loop (measured ~2x slower on CPU and hostile to
+    sharding). Output shape is fixed by the bin count alone, so
+    accumulating this per rung keeps the telemetry payload independent
+    of the config count — the resident tier's flat-host-link contract.
+    """
+    edges = jnp.asarray(edges, jnp.float32)
+    losses = losses.astype(jnp.float32)
+    crashed = jnp.isnan(losses)
+    w = jnp.where(crashed, 0, 1).astype(jnp.int32)
+    # NaN compares false against every edge, but the weight mask is the
+    # authoritative exclusion (it also keeps the total-count arithmetic
+    # honest for the overflow bin)
+    le = (losses[:, None] <= edges[None, :]).astype(jnp.int32) * w[:, None]
+    cum = jnp.sum(le, axis=0)  # finite losses at or below each edge
+    total = jnp.sum(w)
+    hist = jnp.concatenate(
+        [cum[:1], jnp.diff(cum), (total - cum[-1])[None]]
+    )
+    return hist, jnp.sum(crashed).astype(jnp.int32)
 
 
 def fused_sh_bracket(

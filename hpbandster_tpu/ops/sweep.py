@@ -45,6 +45,7 @@ from hpbandster_tpu.ops.fused import (
     _pack_stages,
     fused_sh_bracket,
     shard_rows,
+    stage_telemetry,
 )
 from hpbandster_tpu.ops.kde import (
     KDE,
@@ -59,7 +60,8 @@ __all__ = ["SpaceCodec", "build_space_codec", "quantize_unit", "random_unit",
            "compile_forbidden_mask", "make_fused_sweep_fn",
            "SweepBracketOutput", "SweepIncumbent", "plan_additions",
            "pow2_capacities", "ResidentSweepOutputs", "resident_rotation",
-           "unstack_resident_outputs"]
+           "unstack_resident_outputs", "DeviceMetrics",
+           "init_device_metrics"]
 
 
 def pow2_capacities(counts: dict, floor: int = 256) -> dict:
@@ -630,6 +632,55 @@ class ResidentSweepOutputs(NamedTuple):
     tail: Tuple[SweepBracketOutput, ...]
 
 
+class DeviceMetrics(NamedTuple):
+    """The in-trace telemetry pytree — the sweep's metrics plane.
+
+    Every leaf is sized by the SCHEDULE (brackets x rungs x bins), never
+    by the config count, so carrying it through ``run_bracket`` and the
+    resident ``lax.scan`` adds a constant to the final d2h payload
+    whatever the sweep size — the resident flat-host-link contract
+    (``bench.py`` ``resident_100k`` asserts it with telemetry ON). Rows
+    beyond a bracket's actual rung count stay at their init value; the
+    host decoder (``obs.device_metrics.decode_device_metrics``) walks
+    the plan shapes and never reads them. Bin layout is owned by
+    ``obs/device_metrics.py`` (``bin_edges()``): ONE schema for the
+    in-trace accumulator and every host twin.
+    """
+
+    #: per-(bracket, rung) loss histogram over the log-spaced bins;
+    #: NaN (crashed) losses are excluded (counted in ``crashes``)
+    loss_hist: jax.Array   # i32[n_brackets, max_rungs, N_BINS]
+    #: per-(bracket, rung) evaluation counts (the static stage widths,
+    #: recorded so the decoded record is self-describing)
+    evals: jax.Array       # i32[n_brackets, max_rungs]
+    #: per-(bracket, rung) crashed (NaN-loss) evaluation counts
+    crashes: jax.Array     # i32[n_brackets, max_rungs]
+    #: per-(bracket, rung) promoted-config counts (rows advancing to the
+    #: next rung; 0 at each bracket's final rung)
+    promotions: jax.Array  # i32[n_brackets, max_rungs]
+    #: per-bracket KDE-refit flag: 1 when the bracket's proposals came
+    #: from a fit with an OPEN model gate (matches the host model's
+    #: largest-trained-budget gate arithmetic)
+    model_fits: jax.Array  # i32[n_brackets]
+    #: per-bracket best FINAL-stage loss (NaN = every candidate crashed,
+    #: same crash-rank ordering as the incumbent fold); the decoder
+    #: derives the running incumbent / improvement deltas from it
+    best_final: jax.Array  # f32[n_brackets]
+
+
+def init_device_metrics(n_brackets: int, max_rungs: int, n_bins: int) -> DeviceMetrics:
+    """Zero-initialized metrics carry (``best_final`` inits to NaN — a
+    bracket that has not run yet has no best)."""
+    return DeviceMetrics(
+        loss_hist=jnp.zeros((n_brackets, max_rungs, n_bins), jnp.int32),
+        evals=jnp.zeros((n_brackets, max_rungs), jnp.int32),
+        crashes=jnp.zeros((n_brackets, max_rungs), jnp.int32),
+        promotions=jnp.zeros((n_brackets, max_rungs), jnp.int32),
+        model_fits=jnp.zeros((n_brackets,), jnp.int32),
+        best_final=jnp.full((n_brackets,), jnp.nan, jnp.float32),
+    )
+
+
 def resident_rotation(plans: Sequence[BracketPlan]) -> Tuple[int, int, int]:
     """``(period, n_rounds, n_tail)`` of a bracket schedule.
 
@@ -737,6 +788,7 @@ def make_fused_sweep_fn(
     shard_sampling: bool = False,
     incumbent_only: bool = False,
     resident: bool = False,
+    device_metrics: bool = False,
 ) -> Callable[..., List[SweepBracketOutput]]:
     """Trace + jit the whole sweep; returns ``fn(seed[, warm_v, warm_l])``.
 
@@ -828,6 +880,20 @@ def make_fused_sweep_fn(
     jax 0.4.37 PJRT heap-corruption hazard, bisected empirically). When
     donation is active the inputs are CONSUMED per call; pass fresh
     arrays (or the previous call's returned state) each time.
+
+    ``device_metrics=True`` threads a fixed-shape :class:`DeviceMetrics`
+    accumulator through every bracket (and the resident scan carry): per
+    rung, log-binned loss histograms, crash/evaluation/promotion counts;
+    per bracket, KDE-refit flags and best-final losses. Payload size is
+    O(brackets x rungs x bins) — independent of the config count, so it
+    rides the existing final d2h without perturbing the resident
+    flat-link bill's shape. The jitted fn then ALSO returns the metrics
+    pytree: ``(result, metrics)``, or with ``return_state``
+    ``(result, metrics, state)``. Every path (unrolled static, dynamic
+    chunked, sharded, resident) accumulates through the same
+    ``run_bracket`` body, so the schema is identical — and parity
+    testable — by construction; decode host-side with
+    ``obs.device_metrics.decode_device_metrics``.
     """
     from hpbandster_tpu.parallel.mesh import is_multiprocess_mesh, shard_count
 
@@ -888,6 +954,15 @@ def make_fused_sweep_fn(
 
     vartypes_dev = jnp.asarray(codec.vartypes)
     cards_dev = jnp.asarray(codec.cards)
+
+    # metrics-plane constants: the bin schema is owned by the obs layer
+    # (ONE definition for the in-trace accumulator and the host decoder)
+    if device_metrics:
+        from hpbandster_tpu.obs.device_metrics import N_BINS, bin_edges
+
+        dm_edges = bin_edges().astype(np.float32)
+        dm_rungs = max(len(p.num_configs) for p in plans) if plans else 0
+        dm_bins = N_BINS
 
     def trained_split(n: int) -> Optional[Tuple[int, int]]:
         """Host-side static twin of the _fit_kde_pair gate."""
@@ -963,7 +1038,9 @@ def make_fused_sweep_fn(
             jax.random.uniform(k_frac, (n0,)) >= random_fraction
         )
         proposals = jnp.where(mb_mask[:, None], model_vecs, rand_vecs)
-        return proposals, mb_mask
+        # any_model rides along for the metrics plane: it is the traced
+        # twin of "a KDE refit ran with an open gate this bracket"
+        return proposals, mb_mask, any_model
 
     if resident:
         rotation, n_rounds, _tail_count = resident_rotation(plans)
@@ -1050,18 +1127,23 @@ def make_fused_sweep_fn(
             jnp.zeros((len(plans),), jnp.float32),
         )
 
-    def run_bracket(b_i, plan, key, obs_v, obs_l, counts, inc):
+    def run_bracket(b_i, plan, key, obs_v, obs_l, counts, inc, metrics):
         """One bracket: sample/propose -> forbidden resampling -> fused
-        rung ladder -> observation append -> incumbent fold.
+        rung ladder -> observation append -> incumbent fold -> metrics
+        accumulation.
 
         ``b_i`` may be a Python int (the unrolled trace) or a traced i32
         (the resident scan's round arithmetic): ``fold_in`` is
         value-deterministic, so both derive identical draws for the same
         bracket index — the resident/unrolled bit-parity contract.
-        Functional: returns updated ``(obs_v, obs_l, counts, inc, out)``
-        without mutating the caller's dicts (the scan carry requires it);
-        ``out`` is the bracket's :class:`SweepBracketOutput` or ``None``
-        under ``incumbent_only``.
+        Functional: returns updated ``(obs_v, obs_l, counts, inc,
+        metrics, out)`` without mutating the caller's dicts (the scan
+        carry requires it); ``out`` is the bracket's
+        :class:`SweepBracketOutput` or ``None`` under ``incumbent_only``;
+        ``metrics`` is the :class:`DeviceMetrics` carry (``None`` when
+        the metrics plane is off — nothing extra is traced then). All
+        metrics writes index row ``b_i``, which works for both the
+        unrolled (concrete) and scanned (traced) index.
         """
         obs_v, obs_l, counts = dict(obs_v), dict(obs_l), dict(counts)
         n0 = plan.num_configs[0]
@@ -1076,6 +1158,10 @@ def make_fused_sweep_fn(
         if n_shards > 1:
             rand_vecs = shard_rows(rand_vecs, mesh, axis)
 
+        #: metrics-plane KDE gate flag for this bracket: traced under the
+        #: dynamic tier (the gate is count-arithmetic), concrete 0/1 on
+        #: the static tier — both are the same host-model gate
+        fit_flag = jnp.zeros((), jnp.int32)
         if dynamic_counts:
             if not any_trainable:
                 # no budget's gate can open even at full capacity
@@ -1084,10 +1170,11 @@ def make_fused_sweep_fn(
                 proposals = rand_vecs
                 mb_mask = jnp.zeros(n0, bool)
             else:
-                proposals, mb_mask = dynamic_proposals(
+                proposals, mb_mask, any_model = dynamic_proposals(
                     obs_v, obs_l, counts, rand_vecs, k_prop, k_frac,
                     k_fit, n0,
                 )
+                fit_flag = any_model.astype(jnp.int32)
         else:
             model_budget = None
             for b in sorted(caps, reverse=True):
@@ -1099,6 +1186,7 @@ def make_fused_sweep_fn(
                 proposals = rand_vecs
                 mb_mask = jnp.zeros(n0, bool)
             else:
+                fit_flag = jnp.ones((), jnp.int32)
                 n = counts[model_budget]
                 n_good, n_bad = trained_split(n)
                 good, bad = _fit_kde_pair_device(
@@ -1197,6 +1285,39 @@ def make_fused_sweep_fn(
                 obs_l[b] = obs_l[b].at[c:c + k_s].set(upd_l)
             counts[b] = c + k_s
 
+        if metrics is not None:
+            # metrics plane: per-rung histograms / crash counts plus the
+            # per-bracket refit flag and best final loss, all written at
+            # row b_i (concrete OR traced — the resident/unrolled parity
+            # contract extends to telemetry). O(n) binning per stage is
+            # trivial next to the stage evaluation it accompanies; the
+            # carried arrays are O(schedule), never O(configs).
+            m_hist, m_ev, m_cr, m_pr = (
+                metrics.loss_hist, metrics.evals, metrics.crashes,
+                metrics.promotions,
+            )
+            depth = len(plan.num_configs)
+            for s, ((_idx_s, losses_s), k_s) in enumerate(
+                zip(stages, plan.num_configs)
+            ):
+                h_s, c_s = stage_telemetry(losses_s, dm_edges)
+                m_hist = m_hist.at[b_i, s].set(h_s)
+                m_ev = m_ev.at[b_i, s].set(k_s)
+                m_cr = m_cr.at[b_i, s].set(c_s)
+                m_pr = m_pr.at[b_i, s].set(
+                    plan.num_configs[s + 1] if s + 1 < depth else 0
+                )
+            _, loss_fin = stages[-1]
+            key_fin = jnp.where(jnp.isnan(loss_fin), _CRASH_RANK, loss_fin)
+            metrics = DeviceMetrics(
+                loss_hist=m_hist, evals=m_ev, crashes=m_cr,
+                promotions=m_pr,
+                model_fits=metrics.model_fits.at[b_i].set(fit_flag),
+                best_final=metrics.best_final.at[b_i].set(
+                    loss_fin[jnp.argmin(key_fin)]
+                ),
+            )
+
         out = None
         if incumbent_only:
             # only the winner leaves the device loop: reduce the final
@@ -1222,7 +1343,7 @@ def make_fused_sweep_fn(
             out = SweepBracketOutput(
                 out_vectors[:n0], mb_mask, idx_packed, loss_packed
             )
-        return obs_v, obs_l, counts, inc, out
+        return obs_v, obs_l, counts, inc, metrics, out
 
     def sweep(
         seed: jax.Array, warm_v=None, warm_l=None, warm_n=None
@@ -1230,6 +1351,13 @@ def make_fused_sweep_fn(
         key = jax.random.key(seed)
         obs_v, obs_l, counts = init_obs_state(warm_v, warm_l, warm_n)
         inc = init_incumbent() if incumbent_only else None
+        # the metrics carry rides the same functional thread as the
+        # incumbent (None = metrics plane off: a registered-empty pytree
+        # node, legal in the scan carry exactly like the inc slot)
+        metrics = (
+            init_device_metrics(len(plans), dm_rungs, dm_bins)
+            if device_metrics else None
+        )
         outputs: List[SweepBracketOutput] = []
         if resident:
             # the resident outer loop: ONE traced round of the bracket
@@ -1238,12 +1366,12 @@ def make_fused_sweep_fn(
             # host between brackets, and program size is O(rotation)
             # instead of O(brackets)
             def round_body(carry, r):
-                obs_v, obs_l, counts, inc = carry
+                obs_v, obs_l, counts, inc, metrics = carry
                 outs = []
                 for pos, plan in enumerate(round_plans):
-                    obs_v, obs_l, counts, inc, out = run_bracket(
+                    obs_v, obs_l, counts, inc, metrics, out = run_bracket(
                         r * rotation + pos, plan, key,
-                        obs_v, obs_l, counts, inc,
+                        obs_v, obs_l, counts, inc, metrics,
                     )
                     if not incumbent_only:
                         outs.append(out)
@@ -1255,17 +1383,17 @@ def make_fused_sweep_fn(
                              for b, v in obs_v.items()}
                     obs_l = {b: shard_rows(l, mesh, axis)
                              for b, l in obs_l.items()}
-                return (obs_v, obs_l, counts, inc), tuple(outs)
+                return (obs_v, obs_l, counts, inc, metrics), tuple(outs)
 
-            (obs_v, obs_l, counts, inc), stacked = jax.lax.scan(
-                round_body, (obs_v, obs_l, counts, inc),
+            (obs_v, obs_l, counts, inc, metrics), stacked = jax.lax.scan(
+                round_body, (obs_v, obs_l, counts, inc, metrics),
                 jnp.arange(n_rounds, dtype=jnp.int32),
             )
             tail_outs: List[SweepBracketOutput] = []
             for j, plan in enumerate(tail_plans):
-                obs_v, obs_l, counts, inc, out = run_bracket(
+                obs_v, obs_l, counts, inc, metrics, out = run_bracket(
                     n_rounds * rotation + j, plan, key,
-                    obs_v, obs_l, counts, inc,
+                    obs_v, obs_l, counts, inc, metrics,
                 )
                 if not incumbent_only:
                     tail_outs.append(out)
@@ -1276,8 +1404,8 @@ def make_fused_sweep_fn(
             )
         else:
             for b_i, plan in enumerate(plans):
-                obs_v, obs_l, counts, inc, out = run_bracket(
-                    b_i, plan, key, obs_v, obs_l, counts, inc
+                obs_v, obs_l, counts, inc, metrics, out = run_bracket(
+                    b_i, plan, key, obs_v, obs_l, counts, inc, metrics
                 )
                 if not incumbent_only:
                     outputs.append(out)
@@ -1296,7 +1424,11 @@ def make_fused_sweep_fn(
                          for b, v in obs_v.items()}
                 obs_l = {b: shard_rows(l, mesh, axis)
                          for b, l in obs_l.items()}
+            if device_metrics:
+                return result, metrics, (obs_v, obs_l, counts)
             return result, (obs_v, obs_l, counts)
+        if device_metrics:
+            return result, metrics
         return result
 
     from hpbandster_tpu.parallel.mesh import is_multiprocess_mesh

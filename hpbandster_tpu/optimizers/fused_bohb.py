@@ -236,6 +236,9 @@ class FusedBOHB:
         #: optional on-device promotion scorer (see FusedH2BO); None = the
         #: plain successive-halving raw-loss top-k
         self.promotion_rank_fn = None
+        #: last run's decoded device-telemetry record (None until a run
+        #: with the metrics plane on completes — obs/device_metrics.py)
+        self.last_device_telemetry: Optional[Dict[str, Any]] = None
 
         # warm start (reference: previous_result= replays old data into the
         # model, SURVEY.md §5): old (config, budget, loss) observations seed
@@ -287,7 +290,7 @@ class FusedBOHB:
         )
 
     def _sweep_key(self, plans, dynamic=False, caps=None, resident=False,
-                   incumbent_only=False):
+                   incumbent_only=False, device_metrics=False):
         if dynamic:
             from hpbandster_tpu.ops.kde import _pallas_fit_requested
 
@@ -324,10 +327,14 @@ class FusedBOHB:
             self.promotion_rank_fn,
             self._conditions_sig,
             self._forbiddens_sig,
+            # telemetry changes the traced program (extra outputs), so
+            # metrics-on and metrics-off executables must never collide
+            bool(device_metrics),
         )
 
     def _build_sweep_fn(self, plans, dynamic=False, caps=None,
-                        resident=False, incumbent_only=False):
+                        resident=False, incumbent_only=False,
+                        device_metrics=False):
         warm_counts = {b: len(l) for b, l in self._warm_l.items()}
         return make_fused_sweep_fn(
             self.eval_fn,
@@ -356,10 +363,12 @@ class FusedBOHB:
             return_state=dynamic and not incumbent_only,
             resident=resident,
             incumbent_only=incumbent_only,
+            device_metrics=device_metrics,
         )
 
     def _sweep_compiled(self, plans, example_args, dynamic=False, caps=None,
-                        resident=False, incumbent_only=False):
+                        resident=False, incumbent_only=False,
+                        device_metrics=False):
         """AOT-compiled sweep executable + honest timing attribution:
         returns ``(compiled, build_compile_seconds, cache_hit)``. Ahead-of-
         time ``lower().compile()`` separates compile from execute time (the
@@ -369,14 +378,16 @@ class FusedBOHB:
         artifacts never double-counts a compile."""
         key = self._sweep_key(plans, dynamic=dynamic, caps=caps,
                               resident=resident,
-                              incumbent_only=incumbent_only)
+                              incumbent_only=incumbent_only,
+                              device_metrics=device_metrics)
         hit = _SWEEP_EXE_CACHE.get(key)
         if hit is not None:
             return hit, 0.0, True
         t0 = time.perf_counter()
         fn = self._build_sweep_fn(plans, dynamic=dynamic, caps=caps,
                                   resident=resident,
-                                  incumbent_only=incumbent_only)
+                                  incumbent_only=incumbent_only,
+                                  device_metrics=device_metrics)
         compiled = fn.lower(*example_args).compile()
         dt = time.perf_counter() - t0
         _SWEEP_EXE_CACHE[key] = compiled
@@ -391,6 +402,7 @@ class FusedBOHB:
         checkpoint_path: Optional[str] = None,
         dynamic_counts: Optional[bool] = None,
         resident: bool = False,
+        device_metrics: Optional[bool] = None,
     ) -> Result:
         """Run brackets as fused device computation(s).
 
@@ -452,6 +464,18 @@ class FusedBOHB:
         ``dynamic_counts=False``. For the incumbent-only variant whose
         host traffic is one seed up + one incumbent down, see
         :meth:`run_incumbent`.
+
+        ``device_metrics`` turns the in-trace metrics plane on
+        (``ops/sweep.py`` ``device_metrics=True``): per-rung loss
+        histograms, crash/promotion counts, KDE-refit flags and the
+        incumbent trail accumulate ON DEVICE (payload O(schedule), never
+        O(configs)) and decode at the end of the run into the obs
+        pipeline — ``sweep.device_metrics.*`` / ``sweep.rung.*`` gauges
+        plus one journaled ``device_telemetry`` record
+        (``obs/device_metrics.py``). ``None`` (default) follows
+        ``HPB_DEVICE_METRICS=1``; off otherwise — telemetry changes the
+        compiled program, so the default is explicit, never inferred
+        from the ambient bus.
         """
         del min_n_workers  # API symmetry with Master.run; no worker pool here
         import jax
@@ -487,6 +511,16 @@ class FusedBOHB:
             (chunk_brackets is not None)
             if dynamic_counts is None else bool(dynamic_counts)
         )
+        from hpbandster_tpu.obs.device_metrics import device_metrics_default
+
+        use_dm = (
+            device_metrics_default()
+            if device_metrics is None else bool(device_metrics)
+        )
+        #: fetched per-chunk metrics pytrees + their bracket schedules —
+        #: decoded once at the end of the run into ONE telemetry record
+        dm_parts: List[Any] = []
+        dm_execute_s = 0.0
         link0 = None
         if plans:
             from hpbandster_tpu.obs.runtime import transfer_counters
@@ -629,17 +663,31 @@ class FusedBOHB:
                     compiled, compile_s, cache_hit = self._sweep_compiled(
                         tuple(chunk_plans), args, dynamic=dynamic,
                         caps=run_caps, resident=resident,
+                        device_metrics=use_dm,
                     )
                     t_exec = time.perf_counter()
                     raw = compiled(*args)  # async dispatch
+                    dm_dev = None
                     if dynamic:
                         # keep the updated observation state ON DEVICE for
-                        # the next chunk; only bracket outputs are fetched
-                        raw, new_state = raw
+                        # the next chunk; only bracket outputs (and the
+                        # O(schedule) metrics pytree) are fetched
+                        if use_dm:
+                            raw, dm_dev, new_state = raw
+                        else:
+                            raw, new_state = raw
+                    elif use_dm:
+                        raw, dm_dev = raw
                     # pipelining: the previous chunk's bookkeeping replays
                     # HERE, concurrent with this chunk's device execution
                     _flush_replay()
                     outputs = jax.device_get(raw)
+                    if dm_dev is not None:
+                        dm_parts.append((
+                            jax.device_get(dm_dev),
+                            [(p.num_configs, p.budgets)
+                             for p in chunk_plans],
+                        ))
                     # span of the device phase (dispatch -> fetch complete).
                     # When the overlapped replay outlasts the device work this
                     # OVERSTATES device-busy seconds, so derived MFU reads
@@ -651,6 +699,14 @@ class FusedBOHB:
                     int(l.nbytes)
                     for l in jax.tree_util.tree_leaves(outputs)
                 )
+                if dm_parts and dm_dev is not None:
+                    # the telemetry rides the same final d2h; its bill is
+                    # O(schedule), measured here rather than asserted
+                    d2h_bytes += sum(
+                        int(np.asarray(l).nbytes)
+                        for l in jax.tree_util.tree_leaves(dm_parts[-1][0])
+                    )
+                    dm_execute_s += execute_s
                 note_transfer("d2h", d2h_bytes)
                 if resident:
                     # scan-stacked per-rotation-position outputs -> the
@@ -762,6 +818,23 @@ class FusedBOHB:
             from hpbandster_tpu.obs.runtime import publish_sweep_transfers
 
             publish_sweep_transfers(link0)
+        if dm_parts:
+            # fold every chunk's device telemetry into ONE decoded record:
+            # gauges for the scraper, a device_telemetry journal record
+            # for summarize/report/anomaly — the obs pipeline's view of
+            # work that never surfaced to host per bracket
+            from hpbandster_tpu.obs.device_metrics import (
+                decode_device_metrics,
+                emit_device_telemetry,
+                publish_device_metrics,
+            )
+
+            decoded = decode_device_metrics(
+                dm_parts, execute_s=dm_execute_s
+            )
+            publish_device_metrics(decoded)
+            emit_device_telemetry(decoded)
+            self.last_device_telemetry = decoded
         self._write_timings_sidecar()
         return Result(
             list(self.iterations) + self.warmstart_iteration, self.config
@@ -772,6 +845,7 @@ class FusedBOHB:
         n_iterations: int = 1,
         profile_dir: Optional[str] = None,
         resident: bool = True,
+        device_metrics: Optional[bool] = None,
     ) -> Dict[str, Any]:
         """Incumbent-only (resident) sweep: the whole multi-bracket
         schedule as one device program whose only host traffic is one
@@ -789,6 +863,14 @@ class FusedBOHB:
         Returns a stats dict: ``incumbent`` (vector/loss/bracket/
         per-bracket bests), ``evaluations``, compile/execute seconds and
         the ``transfers`` delta dict.
+
+        ``device_metrics`` (default: ``HPB_DEVICE_METRICS``) turns the
+        in-trace metrics plane on: the O(schedule) telemetry pytree
+        rides the incumbent's d2h — per-rung histograms and crash/
+        promotion counts for a sweep whose per-rung decisions otherwise
+        never leave the device — decoded into the gauges + one
+        ``device_telemetry`` record, and returned under
+        ``"device_telemetry"``.
         """
         import jax
 
@@ -831,6 +913,12 @@ class FusedBOHB:
             warm_l_pad[b] = buf_l
             warm_n[b] = np.int32(n)
         args = (seed, warm_v_pad, warm_l_pad, warm_n)
+        from hpbandster_tpu.obs.device_metrics import device_metrics_default
+
+        use_dm = (
+            device_metrics_default()
+            if device_metrics is None else bool(device_metrics)
+        )
         link0 = transfer_counters()
         upload_bytes = sum(
             int(getattr(l, "nbytes", 0))
@@ -841,13 +929,26 @@ class FusedBOHB:
             compiled, compile_s, cache_hit = self._sweep_compiled(
                 tuple(plans), args, dynamic=True, caps=run_caps,
                 resident=resident, incumbent_only=True,
+                device_metrics=use_dm,
             )
             t0 = time.perf_counter()
-            inc = jax.device_get(compiled(*args))
+            raw = compiled(*args)
+            dm_host = None
+            if use_dm:
+                inc, dm_dev = raw
+                inc, dm_host = jax.device_get((inc, dm_dev))
+            else:
+                inc = jax.device_get(raw)
             execute_s = time.perf_counter() - t0
+        dm_leaves = (
+            list(jax.tree_util.tree_leaves(dm_host))
+            if dm_host is not None else []
+        )
         note_transfer(
             "d2h",
-            sum(int(np.asarray(l).nbytes) for l in inc), buffers=len(inc),
+            sum(int(np.asarray(l).nbytes) for l in inc)
+            + sum(int(np.asarray(l).nbytes) for l in dm_leaves),
+            buffers=len(inc) + len(dm_leaves),
         )
         link = publish_sweep_transfers(link0)
         evaluations = int(sum(sum(p.num_configs) for p in plans))
@@ -865,7 +966,7 @@ class FusedBOHB:
             h2d_bytes=link["transfer_bytes_h2d"],
             host_syncs=link["transfers_h2d"] + link["transfers_d2h"],
         )
-        return {
+        out = {
             "incumbent": {
                 "vector": vector,
                 "loss": loss,
@@ -878,6 +979,21 @@ class FusedBOHB:
             "execute_fetch_s": round(execute_s, 4),
             "transfers": link,
         }
+        if dm_host is not None:
+            from hpbandster_tpu.obs.device_metrics import (
+                decode_device_metrics,
+                emit_device_telemetry,
+                publish_device_metrics,
+            )
+
+            decoded = decode_device_metrics(
+                dm_host, plans=plans, execute_s=execute_s
+            )
+            publish_device_metrics(decoded)
+            emit_device_telemetry(decoded)
+            self.last_device_telemetry = decoded
+            out["device_telemetry"] = decoded
+        return out
 
     def _can_stream_warm(self, multiprocess: bool, run_caps) -> bool:
         """Streamed per-shard warm uploads apply on single-process meshes

@@ -189,6 +189,7 @@ def run_sharded_fused_sweep(
     chunk_brackets: Optional[int] = None,
     publish_gauges: bool = True,
     resident: bool = False,
+    device_metrics: Optional[bool] = None,
 ) -> Dict[str, Any]:
     """Mesh-sharded fused successive halving at 100k-1M config scale.
 
@@ -216,6 +217,15 @@ def run_sharded_fused_sweep(
     ``sweep_incumbent`` audit record (``obs replay`` re-scores it) —
     the flat-d2h claim is measured, not asserted. Replaces
     ``chunk_brackets`` (passing both is an error).
+
+    ``device_metrics`` (default: ``HPB_DEVICE_METRICS``) turns the
+    in-trace metrics plane on (``ops/sweep.py`` ``device_metrics=True``):
+    per-rung loss histograms and crash/promotion counts accumulate on
+    device and ride the incumbent's d2h — an O(schedule) constant, so
+    the flat-host-link bill stays flat in config count WITH telemetry
+    enabled (the ``resident_100k`` bench tier measures exactly that).
+    The decoded record is published as gauges, journaled as
+    ``device_telemetry``, and returned under ``"device_telemetry"``.
 
     Returns a stats dict (incumbent, per-device balance, chunk timings).
     SPMD multi-host: call on every rank with identical arguments over a
@@ -259,6 +269,12 @@ def run_sharded_fused_sweep(
         else max(int(chunk_brackets), 1)
     )
     dynamic = resident or chunk_brackets is not None
+    from hpbandster_tpu.obs.device_metrics import device_metrics_default
+
+    use_dm = (
+        device_metrics_default()
+        if device_metrics is None else bool(device_metrics)
+    )
     sweep_kwargs: Dict[str, Any] = dict(
         num_samples=num_samples,
         mesh=mesh,
@@ -318,6 +334,8 @@ def run_sharded_fused_sweep(
     chunks: List[Dict[str, Any]] = []
     best: Optional[Dict[str, Any]] = None
     per_bracket_all: List[float] = []
+    dm_parts: List[Any] = []
+    dm_execute_s = 0.0
     state = None
     remaining = list(plans)
     bracket_base = 0
@@ -339,6 +357,10 @@ def run_sharded_fused_sweep(
                 # trace-time flag (ops/kde.py): an env flip must miss
                 # the cache, not serve the other fit path's executable
                 _pallas_fit_requested(),
+                # telemetry adds outputs to the traced program — the
+                # metrics-on executable must never serve a metrics-off
+                # call (or vice versa)
+                use_dm,
             )
             cached = _SHARDED_FN_CACHE.get(cache_key)
             if cached is None:
@@ -350,6 +372,7 @@ def run_sharded_fused_sweep(
                     # there is no next chunk to thread state into
                     return_state=dynamic and not resident,
                     resident=resident,
+                    device_metrics=use_dm,
                     **sweep_kwargs,
                 )
                 _SHARDED_FN_CACHE[cache_key] = cached
@@ -377,15 +400,34 @@ def run_sharded_fused_sweep(
         note_transfer("h2d", upload_bytes)
         t0 = time.perf_counter()
         out = fn(*args)
+        dm_dev = None
         if dynamic and not resident:
-            inc, state = out
+            if use_dm:
+                inc, dm_dev, state = out
+            else:
+                inc, state = out
+        elif use_dm:
+            inc, dm_dev = out
         else:
             inc = out
         inc = jax.device_get(inc)
+        dm_host = jax.device_get(dm_dev) if dm_dev is not None else None
         execute_s = time.perf_counter() - t0
+        dm_leaves = (
+            list(jax.tree_util.tree_leaves(dm_host))
+            if dm_host is not None else []
+        )
+        if dm_host is not None:
+            dm_parts.append((
+                dm_host,
+                [(p.num_configs, p.budgets) for p in chunk_plans],
+            ))
+            dm_execute_s += execute_s
         note_transfer(
             "d2h",
-            sum(int(np.asarray(l).nbytes) for l in inc), buffers=len(inc),
+            sum(int(np.asarray(l).nbytes) for l in inc)
+            + sum(int(np.asarray(l).nbytes) for l in dm_leaves),
+            buffers=len(inc) + len(dm_leaves),
         )
         loss = float(np.asarray(inc.loss))
         cand = {
@@ -436,6 +478,24 @@ def run_sharded_fused_sweep(
     # re-score (per-rung decisions never left the device)
     link = publish_sweep_transfers(link0)
     host_syncs = link["transfers_h2d"] + link["transfers_d2h"]
+    decoded_dm = None
+    if dm_parts:
+        # the metrics plane's host half: one decoded record per sweep —
+        # gauges for the scraper, a device_telemetry journal record for
+        # summarize/report and the anomaly rules (every rank publishes
+        # its own copy, like the incumbent record: SPMD values are
+        # identical on all ranks)
+        from hpbandster_tpu.obs.device_metrics import (
+            decode_device_metrics,
+            emit_device_telemetry,
+            publish_device_metrics,
+        )
+
+        decoded_dm = decode_device_metrics(
+            dm_parts, execute_s=dm_execute_s
+        )
+        publish_device_metrics(decoded_dm)
+        emit_device_telemetry(decoded_dm)
     if best is not None:
         from hpbandster_tpu.obs.audit import emit_sweep_incumbent
 
@@ -471,6 +531,7 @@ def run_sharded_fused_sweep(
             sum(c["execute_fetch_s"] for c in chunks), 4
         ),
         "resident": bool(resident),
+        "device_telemetry": decoded_dm,
         "per_bracket_loss": per_bracket_all,
         # measured host-link bill for THIS sweep (note_transfer deltas):
         # the resident tier's flat-d2h / constant-host-sync evidence

@@ -6,13 +6,24 @@ ties inside a front) over two objectives per candidate:
 
 * **loss** — the rung's evaluation result, NaN for crashed configs
   (hard-excluded from promotion, whatever ``k``);
-* **cost** — the measured evaluation expense:
-  :meth:`~hpbandster_tpu.core.iteration.BaseIteration.measured_cost`
-  reads the ``cost`` an evaluation reported in its info payload (a
-  worker measuring device seconds) and falls back to the
-  started->finished wall span the job timestamp schema records — the
-  same numbers the audit stream journals and the obs latency histograms
-  aggregate, so the promotion ranks by what the fleet actually paid.
+* **cost** — the measured evaluation expense, resolved in feed order:
+
+  1. the ``cost`` the evaluation reported in its info payload (a worker
+     measuring device seconds) — the only genuinely per-candidate
+     measurement, always preferred;
+  2. the **obs-histogram feed**
+     (:func:`~hpbandster_tpu.obs.device_metrics.budget_cost_from_obs`):
+     the budget's aggregate evaluation cost from the master's
+     budget-keyed ``job_run_s`` histograms, else from the
+     ``sweep.budget_cost_s.<budget>`` gauges the device-telemetry
+     decoder derives — the pipeline's measurement rather than one job's
+     noisy span. With no per-candidate measurements the rung's costs
+     are then uniform and the Pareto rule degrades EXACTLY to the
+     single-objective SH ranking — by design: host-side wall jitter
+     must not reorder promotions;
+  3. the started->finished wall span the job timestamp schema records
+     — the fallback used only when no histogram feed exists.
+
   An unmeasured cost is NaN -> +inf in the kernel: never an advantage.
 
 The decision stays synchronous (barrier semantics like the paper's
@@ -44,7 +55,10 @@ class ParetoIteration(BaseIteration):
 
     ``cost_fn(datum, budget) -> float | None`` overrides the cost
     measurement (tests pin hand-built fronts with it; a deployment could
-    rank on a worker-reported energy counter).
+    rank on a worker-reported energy counter). ``obs_cost=False`` skips
+    the obs-histogram feed (reported cost -> wall span, the pre-feed
+    behavior); ``cost_registry`` points the feed at a specific metrics
+    registry (tests — default: the process registry).
     """
 
     promotion_rule = "pareto"
@@ -53,17 +67,55 @@ class ParetoIteration(BaseIteration):
         self,
         *args,
         cost_fn: Optional[Callable[[Datum, float], Optional[float]]] = None,
+        obs_cost: bool = True,
+        cost_registry=None,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
         self.cost_fn = cost_fn
+        self.obs_cost = bool(obs_cost)
+        self.cost_registry = cost_registry
+        #: (budget, feed value) of the last obs-feed lookup: a rung
+        #: decision calls promotion_cost once per candidate (and again
+        #: for the audit costs list) at ONE budget, and each raw lookup
+        #: snapshots the whole registry — resolve it once per budget,
+        #: not once per candidate
+        self._feed_cache: Optional[tuple] = None
+
+    def _obs_feed(self, budget: float) -> Optional[float]:
+        if not self.obs_cost:
+            return None
+        key = float(budget)
+        if self._feed_cache is not None and self._feed_cache[0] == key:
+            return self._feed_cache[1]
+        from hpbandster_tpu.obs.device_metrics import budget_cost_from_obs
+
+        feed = budget_cost_from_obs(key, registry=self.cost_registry)
+        # caching per budget also makes the decision self-consistent: a
+        # histogram update landing mid-rung cannot hand two candidates
+        # different aggregate costs
+        self._feed_cache = (key, feed)
+        return feed
 
     def promotion_cost(self, config_id: ConfigId, budget: float):
-        """The audit record's cost column IS the ranking input here."""
+        """The audit record's cost column IS the ranking input here.
+
+        Feed order (module docstring): explicit ``cost_fn`` >
+        per-candidate reported cost > obs-histogram aggregate
+        (:func:`~hpbandster_tpu.obs.device_metrics.budget_cost_from_obs`,
+        resolved once per budget) > per-job wall span — spans only when
+        no histogram feed exists.
+        """
         if self.cost_fn is not None:
             cost = self.cost_fn(self.data[config_id], budget)
             return float(cost) if cost is not None else None
-        return self.measured_cost(config_id, budget)
+        reported = self.reported_cost(config_id, budget)
+        if reported is not None:
+            return reported
+        feed = self._obs_feed(budget)
+        if feed is not None:
+            return feed
+        return self.wall_span_cost(config_id, budget)
 
     def _cost_of(self, config_id: ConfigId, budget: float) -> float:
         cost = self.promotion_cost(config_id, budget)

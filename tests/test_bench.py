@@ -292,6 +292,15 @@ class TestResidentTier:
             assert len(bills) == 1
             assert all(row["dispatches"] == 1 for row in out["per_size"])
             assert out["per_size"][0]["h2d_bytes"] == 4  # one uint32 seed
+            # ISSUE 13: the flat bill above was measured WITH the device
+            # metrics plane ON — the telemetry payload rides the same
+            # final d2h and stays O(schedule)
+            assert out["device_metrics_enabled"] is True
+            assert out["device_telemetry"]["rounds_completed"] == 3
+            assert (
+                out["device_telemetry"]["evaluations"]
+                == out["per_size"][-1]["evaluations"]
+            )
             # the KDE-fit probe measured and reported
             assert set(out["kde_fit_s"]) == {"4096", "16384"}
             assert all(v >= 0 for v in out["kde_fit_s"].values())

@@ -1,0 +1,672 @@
+"""Device metrics plane tests (ISSUE 13).
+
+The tentpole contract: a fixed-shape telemetry pytree
+(``ops.sweep.DeviceMetrics``) accumulates per-rung loss histograms,
+crash/evaluation/promotion counts, KDE-refit flags and the incumbent
+trail IN-TRACE — through the unrolled, chunked, sharded AND resident
+paths (one shared ``run_bracket``, so the schema is identical by
+construction) — with a payload independent of the config count, and the
+host decoder (``obs/device_metrics.py``) folds it into the obs pipeline:
+gauges, a ``device_telemetry`` journal record, Prometheus families,
+anomaly feeds, the summarize/report/top surfaces, and the Pareto cost
+objective.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from hpbandster_tpu import obs
+from hpbandster_tpu.obs.device_metrics import (
+    N_BINS,
+    bin_edges,
+    bin_index_np,
+    budget_cost_from_obs,
+    decode_device_metrics,
+    device_section_from_records,
+    hist_quantile,
+)
+from hpbandster_tpu.obs.metrics import MetricsRegistry
+from hpbandster_tpu.ops.bracket import (
+    BracketPlan,
+    hyperband_schedule,
+    mesh_aligned_plan,
+)
+from hpbandster_tpu.ops.sweep import (
+    build_space_codec,
+    make_fused_sweep_fn,
+    plan_additions,
+    pow2_capacities,
+)
+from hpbandster_tpu.parallel.mesh import config_mesh
+from hpbandster_tpu.parallel.multihost import run_sharded_fused_sweep
+from hpbandster_tpu.workloads.toys import branin_from_vector, branin_space
+
+
+def _host_hist(losses) -> np.ndarray:
+    """Host twin of the in-trace accumulation, built independently."""
+    losses = np.asarray(losses, np.float32)
+    hist = np.zeros(N_BINS, np.int64)
+    mask = ~np.isnan(losses)
+    np.add.at(hist, bin_index_np(losses)[mask], 1)
+    return hist
+
+
+def _crashy(v, budget):
+    """Branin whose loss crashes (NaN) on a deterministic config slice."""
+    import jax.numpy as jnp
+
+    loss = branin_from_vector(v, budget)
+    return jnp.where(v[0] < 0.2, jnp.nan, loss)
+
+
+class TestSchema:
+    def test_bin_edges_monotonic_and_sized(self):
+        e = bin_edges()
+        assert e.shape == (N_BINS - 1,)
+        assert np.all(np.diff(e) > 0)
+        assert e[0] == pytest.approx(1e-6)
+        assert e[-1] == pytest.approx(1e6)
+
+    def test_bin_index_matches_registry_histogram_convention(self):
+        """A value equal to a bound lands IN that bucket — the same
+        bisect_left rule obs.metrics.Histogram uses."""
+        import bisect
+
+        e = bin_edges().astype(np.float32)
+        vals = np.array(
+            [0.0, -3.0, float(e[0]), float(e[5]), 1e-7, 1e7, np.inf,
+             0.5, 123.0],
+            np.float32,
+        )
+        idx = bin_index_np(vals)
+        for v, i in zip(vals, idx):
+            assert i == min(bisect.bisect_left(list(e), v), N_BINS - 1)
+
+    def test_hist_quantile_conservative_upper_bound(self):
+        hist = [0] * N_BINS
+        hist[3] = 10
+        hist[7] = 10
+        e = bin_edges()
+        assert hist_quantile(hist, 0.5) == pytest.approx(float(e[3]))
+        assert hist_quantile(hist, 0.95) == pytest.approx(float(e[7]))
+        assert hist_quantile([0] * N_BINS, 0.5) is None
+        # quantile in the overflow bin has no honest upper bound
+        over = [0] * N_BINS
+        over[N_BINS - 1] = 5
+        assert hist_quantile(over, 0.5) is None
+
+
+class TestStageTelemetry:
+    def test_matches_host_twin_incl_nan_inf(self):
+        from hpbandster_tpu.ops.fused import stage_telemetry
+
+        losses = np.array(
+            [0.5, 1e-9, np.nan, np.inf, 3.0, np.nan, -2.0, 1e7, 0.0],
+            np.float32,
+        )
+        hist, crashes = jax.jit(
+            lambda l: stage_telemetry(l, bin_edges().astype(np.float32))
+        )(losses)
+        assert int(crashes) == 2
+        assert np.array_equal(np.asarray(hist), _host_hist(losses))
+        assert int(np.asarray(hist).sum()) == len(losses) - 2
+
+    def test_bucketed_stage_telemetry_masks_padding(self):
+        """Rows past a bucketed stage's traced count are padding — they
+        must contribute to NEITHER the histogram NOR the crash count."""
+        from hpbandster_tpu.ops.buckets import bucketed_stage_telemetry
+
+        losses = np.array([1.0, np.nan, 2.0, np.nan, 777.0], np.float32)
+        idx = np.arange(5, dtype=np.int32)
+        out = jax.jit(
+            lambda l: bucketed_stage_telemetry(
+                [(idx, l)], np.array([3], np.int32),
+                bin_edges().astype(np.float32),
+            )
+        )(losses)
+        hist, crashes = out[0]
+        # live rows: 1.0, NaN, 2.0 — the padding NaN and 777.0 excluded
+        assert int(crashes) == 1
+        assert np.array_equal(
+            np.asarray(hist), _host_hist(np.array([1.0, 2.0], np.float32))
+        )
+
+
+class TestSweepAccumulator:
+    def _run(self, eval_fn, plans, seed=7, **kw):
+        cs = branin_space(seed=0)
+        codec = build_space_codec(cs)
+        fn = make_fused_sweep_fn(
+            eval_fn, plans, codec, device_metrics=True, **kw
+        )
+        return jax.device_get(fn(np.uint32(seed)))
+
+    def test_static_sweep_counts_match_outputs(self):
+        """Device counters vs an independent host recomputation from the
+        sweep's own fetched stage losses."""
+        plans = hyperband_schedule(4, 1, 9, 3)
+        outs, dm = self._run(_crashy, plans)
+        hist = np.asarray(dm.loss_hist)
+        evals = np.asarray(dm.evals)
+        crashes = np.asarray(dm.crashes)
+        promos = np.asarray(dm.promotions)
+        best = np.asarray(dm.best_final)
+        total_crashes = 0
+        for b_i, (plan, out) in enumerate(zip(plans, outs)):
+            off = 0
+            for s, k in enumerate(plan.num_configs):
+                losses_s = np.asarray(out.loss_packed[off:off + k])
+                off += k
+                assert evals[b_i, s] == k
+                assert crashes[b_i, s] == int(np.isnan(losses_s).sum())
+                total_crashes += int(np.isnan(losses_s).sum())
+                assert np.array_equal(hist[b_i, s], _host_hist(losses_s))
+                want_promo = (
+                    plan.num_configs[s + 1]
+                    if s + 1 < len(plan.num_configs) else 0
+                )
+                assert promos[b_i, s] == want_promo
+            # best final-stage loss (crash-ranked)
+            k_fin = plan.num_configs[-1]
+            fin = np.asarray(out.loss_packed[-k_fin:])
+            key = np.where(np.isnan(fin), np.float32(3.0e38), fin)
+            want = fin[int(np.argmin(key))]
+            got = best[b_i]
+            assert (np.isnan(want) and np.isnan(got)) or want == got
+        assert total_crashes > 0, "crash parity vacuous: nothing crashed"
+        # rows beyond a shallow bracket's depth stay at init
+        depths = [len(p.num_configs) for p in plans]
+        for b_i, d in enumerate(depths):
+            assert np.all(evals[b_i, d:] == 0)
+
+    def test_counts_match_journal_on_fused_driver(self):
+        """ISSUE 13 acceptance: decoded per-rung crash/promotion counts
+        bit-match the unrolled path's host-side journal on the same
+        seed."""
+        from hpbandster_tpu.optimizers import FusedBOHB
+
+        records = []
+        detach = obs.get_bus().subscribe(records.append)
+        try:
+            cs = branin_space(seed=0)
+            opt = FusedBOHB(
+                configspace=cs, eval_fn=_crashy, run_id="dm-journal",
+                min_budget=1, max_budget=9, eta=3, seed=21,
+            )
+            opt.run(n_iterations=4, dynamic_counts=True,
+                    device_metrics=True)
+        finally:
+            detach()
+        decoded = opt.last_device_telemetry
+        assert decoded is not None
+        # journal crash counts per budget: the loss-carrying job records
+        by_budget_crash = {}
+        by_budget_evals = {}
+        for r in records:
+            if r.name in ("job_finished", "job_failed"):
+                b = float(r.fields["budget"])
+                by_budget_evals[b] = by_budget_evals.get(b, 0) + 1
+                if r.fields.get("loss") is None:
+                    by_budget_crash[b] = by_budget_crash.get(b, 0) + 1
+        by_budget_promo = {}
+        for r in records:
+            if r.name == "promotion_decision":
+                b = float(r.fields["budget"])
+                by_budget_promo[b] = (
+                    by_budget_promo.get(b, 0) + int(r.fields["n_promoted"])
+                )
+        assert sum(by_budget_crash.values()) > 0, "vacuous: no crashes"
+        for rung in decoded["rungs"]:
+            b = float(rung["budget"])
+            assert rung["evals"] == by_budget_evals.get(b, 0)
+            assert rung["crashes"] == by_budget_crash.get(b, 0)
+            assert rung["promotions"] == by_budget_promo.get(b, 0)
+        # ... and the device_telemetry record itself was journaled
+        dt = [r for r in records if r.name == "device_telemetry"]
+        assert len(dt) == 1
+        assert dt[0].fields["evaluations"] == decoded["evaluations"]
+
+    def test_resident_metrics_bit_match_unrolled(self):
+        """Telemetry extends the resident/unrolled bit-parity contract:
+        the metrics pytree is leaf-for-leaf identical across the two
+        program shapes (traced vs concrete bracket index writes)."""
+        cs = branin_space(seed=0)
+        codec = build_space_codec(cs)
+        d = int(codec.kind.shape[0])
+        plans = hyperband_schedule(5, 1, 9, 3)  # period 3 -> tail of 2
+        caps = pow2_capacities(plan_additions(plans))
+        kw = dict(dynamic_counts=True, capacities=caps,
+                  device_metrics=True)
+        fn_u = make_fused_sweep_fn(_crashy, plans, codec, **kw)
+        fn_r = make_fused_sweep_fn(
+            _crashy, plans, codec, resident=True, **kw
+        )
+
+        def warm():
+            wv = {b: np.zeros((c, d), np.float32) for b, c in caps.items()}
+            wl = {b: np.full(c, np.inf, np.float32) for b, c in caps.items()}
+            wn = {b: np.int32(0) for b in caps}
+            return wv, wl, wn
+
+        _, dm_u = jax.device_get(fn_u(np.uint32(11), *warm()))
+        _, dm_r = jax.device_get(fn_r(np.uint32(11), *warm()))
+        for name, a, b in zip(dm_u._fields, dm_u, dm_r):
+            assert np.array_equal(
+                np.asarray(a), np.asarray(b), equal_nan=True
+            ), f"metrics leaf {name} diverged"
+        assert np.asarray(dm_u.crashes).sum() > 0
+
+    def test_payload_independent_of_config_count(self):
+        cs = branin_space(seed=0)
+        codec = build_space_codec(cs)
+        mesh = config_mesh(jax.devices())
+        sizes = {}
+        for n in (1024, 4096):
+            plan = mesh_aligned_plan(n, 1, 9, 3, len(jax.devices()))
+            plans = [plan] * 2
+            caps = pow2_capacities(plan_additions(plans))
+            fn = make_fused_sweep_fn(
+                branin_from_vector, plans, codec, dynamic_counts=True,
+                capacities=caps, mesh=mesh, shard_sampling=True,
+                incumbent_only=True, resident=True, device_metrics=True,
+                min_points_in_model=2**30,
+            )
+            d = int(codec.kind.shape[0])
+            wv = {b: np.zeros((c, d), np.float32) for b, c in caps.items()}
+            wl = {b: np.full(c, np.inf, np.float32) for b, c in caps.items()}
+            wn = {b: np.int32(0) for b in caps}
+            _, dm = jax.device_get(fn(np.uint32(1), wv, wl, wn))
+            sizes[n] = sum(int(np.asarray(l).nbytes) for l in dm)
+        assert sizes[1024] == sizes[4096]
+
+    def test_all_crashed_edge(self):
+        import jax.numpy as jnp
+
+        plans = [BracketPlan((9, 3), (1.0, 3.0))]
+        outs, dm = self._run(
+            lambda v, b: jnp.float32(jnp.nan) * v[0], plans
+        )
+        decoded = decode_device_metrics(dm, plans=plans)
+        assert decoded["crashes"] == decoded["evaluations"] == 12
+        assert decoded["crash_rate"] == 1.0
+        assert decoded["per_bracket_best"] == [None]
+        assert decoded["incumbent_after"] == [None]
+        for rung in decoded["rungs"]:
+            assert sum(rung["hist"]) == 0
+            assert rung["loss_p50"] is None
+
+
+class TestDecode:
+    def _decoded(self):
+        plans = hyperband_schedule(3, 1, 9, 3)
+        cs = branin_space(seed=0)
+        codec = build_space_codec(cs)
+        fn = make_fused_sweep_fn(
+            _crashy, plans, codec, device_metrics=True
+        )
+        _, dm = jax.device_get(fn(np.uint32(5)))
+        return dm, plans
+
+    def test_bit_stable_across_invocations(self):
+        dm, plans = self._decoded()
+        a = decode_device_metrics(dm, plans=plans, execute_s=1.25)
+        b = decode_device_metrics(dm, plans=plans, execute_s=1.25)
+        assert json.dumps(a, sort_keys=True) == json.dumps(
+            b, sort_keys=True
+        )
+        json.dumps(a, allow_nan=False)  # strict-JSON safe
+
+    def test_multi_chunk_merge_equals_single_decode(self):
+        """Decoding two chunks' parts == decoding one pytree covering
+        the same schedule (the chunked driver's merge path)."""
+        plans = hyperband_schedule(4, 1, 9, 3)
+        cs = branin_space(seed=0)
+        codec = build_space_codec(cs)
+        fn_all = make_fused_sweep_fn(
+            branin_from_vector, plans, codec, device_metrics=True
+        )
+        _, dm_all = jax.device_get(fn_all(np.uint32(3)))
+        # split the pytree by bracket into two parts
+        import numpy as _np
+
+        def part(sl, plan_slice):
+            return (
+                type(dm_all)(*[_np.asarray(l)[sl] for l in dm_all]),
+                plan_slice,
+            )
+
+        merged = decode_device_metrics(
+            [part(slice(0, 2), plans[:2]), part(slice(2, 4), plans[2:])]
+        )
+        single = decode_device_metrics(dm_all, plans=plans)
+        assert json.dumps(merged, sort_keys=True) == json.dumps(
+            single, sort_keys=True
+        )
+
+    def test_est_cost_feeds_budget_gauges(self):
+        dm, plans = self._decoded()
+        decoded = decode_device_metrics(dm, plans=plans, execute_s=2.0)
+        costs = {r["budget"]: r.get("est_cost_s") for r in decoded["rungs"]}
+        assert all(c is not None and c > 0 for c in costs.values())
+        # the split follows evals x budget: total re-assembles execute_s
+        total = sum(
+            r["est_cost_s"] * r["evals"] for r in decoded["rungs"]
+        )
+        assert total == pytest.approx(2.0, rel=1e-3)
+        reg = MetricsRegistry()
+        from hpbandster_tpu.obs.device_metrics import publish_device_metrics
+
+        publish_device_metrics(decoded, registry=reg)
+        g = reg.snapshot()["gauges"]
+        assert g["sweep.device_metrics.evaluations"] == decoded["evaluations"]
+        for b, c in costs.items():
+            assert g[f"sweep.budget_cost_s.{b:g}"] == pytest.approx(c)
+
+    def test_plan_mismatch_raises(self):
+        dm, plans = self._decoded()
+        with pytest.raises(ValueError, match="brackets"):
+            decode_device_metrics(dm, plans=plans[:1])
+
+
+class TestShardedDriver:
+    def test_flat_bill_with_telemetry_on(self):
+        """ISSUE 13 acceptance: resident sweep with telemetry ON — d2h
+        bytes identical across config counts (flat), telemetry riding
+        the same final d2h."""
+        cs = branin_space(seed=0)
+        mesh = config_mesh(jax.devices())
+        bills = {}
+        base_bills = {}
+        for n in (1024, 8192):
+            r = run_sharded_fused_sweep(
+                branin_from_vector, cs, n_configs=n, min_budget=1,
+                max_budget=9, eta=3, mesh=mesh, seed=3, n_brackets=3,
+                resident=True, device_metrics=True,
+            )
+            bills[n] = (r["d2h_bytes"], r["h2d_bytes"], r["host_syncs"])
+            assert r["device_telemetry"] is not None
+            assert r["device_telemetry"]["rounds_completed"] == 3
+            b = run_sharded_fused_sweep(
+                branin_from_vector, cs, n_configs=n, min_budget=1,
+                max_budget=9, eta=3, mesh=mesh, seed=3, n_brackets=3,
+                resident=True, device_metrics=False,
+            )
+            base_bills[n] = b["d2h_bytes"]
+        assert bills[1024] == bills[8192], bills
+        # the telemetry bill is the O(schedule) pytree, nothing more
+        assert bills[1024][0] > base_bills[1024]
+        assert (
+            bills[1024][0] - base_bills[1024]
+            == bills[8192][0] - base_bills[8192]
+        )
+
+    def test_incumbent_driver_returns_telemetry(self):
+        from hpbandster_tpu.optimizers import FusedBOHB
+
+        cs = branin_space(seed=0)
+        opt = FusedBOHB(
+            configspace=cs, eval_fn=branin_from_vector, run_id="dm-inc",
+            min_budget=1, max_budget=9, eta=3, seed=13,
+        )
+        out = opt.run_incumbent(n_iterations=3, device_metrics=True)
+        dt = out["device_telemetry"]
+        assert dt["evaluations"] == out["evaluations"]
+        assert dt["rounds_completed"] == 3
+        # incumbent parity: the telemetry's running best equals the
+        # incumbent payload's loss
+        assert dt["incumbent_after"][-1] == pytest.approx(
+            out["incumbent"]["loss"], rel=1e-6
+        )
+
+
+class TestAnomalyFeeds:
+    def test_nan_burst_from_device_counters(self):
+        rec = {
+            "event": "device_telemetry", "t_wall": 1.0,
+            "crashes": 6, "evaluations": 12,
+        }
+        alerts = obs.scan_records([rec])
+        assert [a["rule"] for a in alerts] == ["nan_burst"]
+        assert alerts[0]["subject"] == "device"
+        # rate gate: the same absolute count in a big sweep is healthy
+        ok = {"event": "device_telemetry", "t_wall": 1.0,
+              "crashes": 6, "evaluations": 100_000}
+        assert obs.scan_records([ok]) == []
+
+    def test_bracket_skew_rule(self):
+        rec = {
+            "event": "device_telemetry", "t_wall": 1.0,
+            "crashes": 10, "evaluations": 1000,
+            "per_bracket_crashes": [0, 10, 0, 0],
+        }
+        alerts = obs.scan_records([rec])
+        assert [a["rule"] for a in alerts] == ["bracket_skew"]
+        assert alerts[0]["subject"] == "bracket1"
+        # spread-out crashes are nan_burst's beat, not skew's
+        spread = dict(rec, per_bracket_crashes=[3, 2, 3, 2])
+        assert obs.scan_records([spread]) == []
+
+    def test_bracket_skew_even_length_uses_true_median(self):
+        """[0, 0, 12, 12]: true median 6 -> skew 0.5 fires; the
+        upper-middle element (12 -> skew 0) would silently disable the
+        rule for symmetric splits on even bracket counts."""
+        rec = {
+            "event": "device_telemetry", "t_wall": 1.0,
+            "crashes": 24, "evaluations": 1000,
+            "per_bracket_crashes": [0, 0, 12, 12],
+        }
+        alerts = obs.scan_records([rec])
+        assert [a["rule"] for a in alerts] == ["bracket_skew"]
+        assert alerts[0]["median_crashes"] == 6.0
+
+    def test_live_detector_matches_offline_scan(self):
+        from hpbandster_tpu.obs.anomaly import AnomalyDetector
+
+        recs = [
+            {"event": "device_telemetry", "t_wall": float(i),
+             "crashes": 8, "evaluations": 16,
+             "per_bracket_crashes": [8, 0]}
+            for i in range(2)
+        ]
+        bus = obs.EventBus()
+        det = AnomalyDetector(bus=bus, registry=MetricsRegistry())
+        live = []
+        for r in recs:
+            live.extend(det.process(dict(r)))
+        offline = obs.scan_records(recs)
+        assert [(a["rule"], a["subject"]) for a in live] == [
+            (a["rule"], a["subject"]) for a in offline
+        ]
+
+
+class TestExportAndSurfaces:
+    def test_sweep_rung_family_round_trip(self):
+        from hpbandster_tpu.obs.export import (
+            parse_prometheus_text,
+            render_snapshot,
+        )
+
+        snap = {
+            "counters": {},
+            "gauges": {
+                "sweep.rung.1.evals": 18.0,
+                "sweep.rung.0.5.loss_p95": 2.5,
+                "sweep.budget_cost_s.9": 0.125,
+                "sweep.device_metrics.crash_rate": 0.25,
+            },
+            "histograms": {
+                "master.job_run_s.b3": {
+                    "count": 9, "sum": 3.0, "p50": 0.3, "p95": 0.5,
+                },
+            },
+        }
+        fams = parse_prometheus_text(render_snapshot(snap))
+        assert fams["hpbandster_sweep_rung_evals"]["samples"] == [
+            ({"budget": "1"}, 18.0)
+        ]
+        # a dotted budget keeps its dot in the label (greedy-label rule)
+        assert fams["hpbandster_sweep_rung_loss_p95"]["samples"] == [
+            ({"budget": "0.5"}, 2.5)
+        ]
+        assert fams["hpbandster_sweep_budget_cost_s"]["samples"] == [
+            ({"budget": "9"}, 0.125)
+        ]
+        assert "hpbandster_sweep_device_metrics_crash_rate" in fams
+        assert ({"budget": "3"}, 0.3) in fams[
+            "hpbandster_master_job_run_s_budget_p50"
+        ]["samples"]
+
+    def _telemetry_record(self):
+        return {
+            "event": "device_telemetry", "t_wall": 1.0,
+            "evaluations": 35, "crashes": 2, "promotions": 9,
+            "model_fits": 3, "rounds_completed": 4,
+            "rungs": [{
+                "budget": 1.0, "evals": 18, "crashes": 2,
+                "promotions": 6,
+                "hist": [0] * 10 + [16] + [0] * (N_BINS - 11),
+            }],
+            "incumbent_after": [2.0, 1.5],
+            "per_bracket_crashes": [1, 1],
+        }
+
+    def test_summarize_section_and_render(self):
+        from hpbandster_tpu.obs.summarize import (
+            format_summary,
+            summarize_records,
+        )
+
+        s = summarize_records([self._telemetry_record()])
+        assert s["device"]["evaluations"] == 35
+        assert s["device"]["best_loss"] == 1.5
+        rung = s["device"]["rungs"][0]
+        assert rung["crash_rate"] == pytest.approx(2 / 18)
+        assert rung["loss_p50"] is not None
+        text = format_summary(s)
+        assert "device telemetry:" in text
+        assert "rung budget=1:" in text
+        # absent section leaves the summary untouched
+        s2 = summarize_records([{"event": "job_finished", "t_wall": 1.0}])
+        assert s2["device"] is None
+        assert "device telemetry:" not in format_summary(s2)
+
+    def test_report_section_deterministic(self):
+        from hpbandster_tpu.obs.report import build_report, format_report
+
+        recs = [self._telemetry_record()]
+        a = build_report(recs)
+        b = build_report([dict(recs[0])])
+        assert json.dumps(a["device"], sort_keys=True) == json.dumps(
+            b["device"], sort_keys=True
+        )
+        assert "device telemetry:" in format_report(a)
+        # summarize and report render the SAME aggregation
+        from hpbandster_tpu.obs.summarize import summarize_records
+
+        assert a["device"] == summarize_records(recs)["device"]
+        assert a["device"] == device_section_from_records(recs)
+
+    def test_top_table_and_watch_line_render_device_section(self):
+        from hpbandster_tpu.obs.collector import (
+            _endpoint_row,
+            format_fleet_table,
+        )
+        from hpbandster_tpu.obs.summarize import _snapshot_device_part
+
+        snap = {
+            "component": "master", "uptime_s": 5,
+            "metrics": {"gauges": {
+                "sweep.device_metrics.evaluations": 120.0,
+                "sweep.device_metrics.crashes": 6.0,
+                "sweep.device_metrics.crash_rate": 0.05,
+                "sweep.device_metrics.rounds": 4.0,
+                "sweep.device_metrics.model_fits": 2.0,
+            }, "counters": {}},
+        }
+        row = _endpoint_row(snap)
+        assert row["device_metrics"]["evaluations"] == 120.0
+        sample = {"fleet": {}, "endpoints": {"m": dict(row, ok=True)}}
+        table = format_fleet_table(sample)
+        assert "device_telemetry: evals=120" in table
+        assert "crashed=6 (5.00%)" in table
+        part = _snapshot_device_part(snap)
+        assert "evals=120" in part and "rounds=4" in part
+        # no telemetry, no part
+        assert _snapshot_device_part({"metrics": {"gauges": {}}}) == ""
+
+
+class TestParetoCostFeed:
+    def _iteration(self, registry, **kw):
+        from hpbandster_tpu.promote.pareto import ParetoIteration
+
+        def sampler(budget):
+            return {"x": 0.5}, {}
+
+        it = ParetoIteration(
+            HPB_iter=0, num_configs=[4, 2], budgets=[1.0, 3.0],
+            config_sampler=sampler, cost_registry=registry, **kw,
+        )
+        return it
+
+    def _datum(self, it, i, loss, wall=None, info_cost=None):
+        from hpbandster_tpu.core.job import Job
+
+        nr = it.get_next_run()
+        cid, cfg, budget = nr
+        job = Job(cid, config=cfg, budget=budget)
+        job.timestamps["submitted"] = 0.0
+        job.timestamps["started"] = 0.0
+        job.timestamps["finished"] = wall if wall is not None else 0.0
+        job.result = {
+            "loss": loss,
+            "info": {"cost": info_cost} if info_cost is not None else {},
+        }
+        it.register_result(job)
+        return cid
+
+    def test_histogram_feed_preferred_over_wall_span(self):
+        reg = MetricsRegistry()
+        for _ in range(10):
+            reg.histogram("master.job_run_s.b1").observe(0.25)
+        it = self._iteration(reg)
+        cids = [
+            self._datum(it, i, loss, wall=10.0 + i)
+            for i, loss in enumerate([1.0, 2.0, 3.0, 4.0])
+        ]
+        # feed exists: every unreported candidate costs the aggregate
+        # (the histogram's conservative bucket-upper-bound p50), NOT its
+        # own (noisy) wall span
+        p50 = reg.snapshot()["histograms"]["master.job_run_s.b1"]["p50"]
+        assert p50 is not None and p50 < 10.0
+        for cid in cids:
+            assert it.promotion_cost(cid, 1.0) == pytest.approx(p50)
+
+    def test_reported_cost_still_wins(self):
+        reg = MetricsRegistry()
+        for _ in range(10):
+            reg.histogram("master.job_run_s.b1").observe(0.25)
+        it = self._iteration(reg)
+        cid = self._datum(it, 0, 1.0, info_cost=7.5)
+        assert it.promotion_cost(cid, 1.0) == 7.5
+
+    def test_wall_span_fallback_without_feed(self):
+        reg = MetricsRegistry()  # empty: no histogram, no gauge
+        it = self._iteration(reg)
+        cid = self._datum(it, 0, 1.0, wall=4.0)
+        assert it.promotion_cost(cid, 1.0) == pytest.approx(4.0)
+
+    def test_gauge_feed_from_device_telemetry(self):
+        reg = MetricsRegistry()
+        reg.gauge("sweep.budget_cost_s.1").set(0.03)
+        it = self._iteration(reg)
+        cid = self._datum(it, 0, 1.0, wall=9.0)
+        assert it.promotion_cost(cid, 1.0) == pytest.approx(0.03)
+
+    def test_budget_cost_from_obs_min_count_gate(self):
+        reg = MetricsRegistry()
+        for _ in range(3):  # below the trust threshold
+            reg.histogram("master.job_run_s.b1").observe(0.25)
+        assert budget_cost_from_obs(1.0, registry=reg) is None
