@@ -48,6 +48,7 @@ a stale copy in a commit is noise, not confusion.
 import argparse
 import json
 import logging
+import math
 import os
 import statistics
 import subprocess
@@ -66,8 +67,8 @@ TIER_ORDER = (
     "cnn", "cnn_wide", "pallas", "resnet", "transformer", "fused_1M",
     "fused_100k", "resident_100k", "fused10k", "chunked10k",
     "chunked_compile", "fused",
-    "rpc", "batched", "teacher", "multitenant", "chaos",
-    "async_straggler", "obs_overhead",
+    "rpc", "batched", "teacher", "multitenant", "serve_continuous",
+    "chaos", "async_straggler", "obs_overhead",
     "runtime_overhead", "collector_overhead", "report_100k",
 )
 
@@ -1607,6 +1608,170 @@ def bench_multitenant(n_tenants=16, repeats=3, max_budget=9, seed=0):
     }
 
 
+def bench_serve_continuous(n_tenants=8, lane_count=4, brackets_per_tenant=2,
+                           repeats=3, max_budget=9, seed=0,
+                           stagger_s=0.02):
+    """Continuous-batching serving tier: steady tenant arrival/departure
+    through the RESIDENT lane programs (``serve/continuous.py``) vs the
+    SAME workload through the one-shot megabatch path.
+
+    ``n_tenants`` concurrent BOHB tenants arrive staggered (``stagger_s``
+    apart — the serving tier's steady-arrival shape) and depart as they
+    finish; each runs ``brackets_per_tenant`` brackets (EQUAL demand, so
+    the fairness yardstick is exact). Reported per arm:
+
+    * ``median``/``iqr`` configs/s over ``repeats`` runs against ONE
+      long-lived pool per arm (a serving pool lives for days — repeats
+      against a fresh pool would re-measure compile, not serving);
+    * ``p95_admission_to_first_result_s`` — per tenant, submission to
+      its FIRST delivered result (the continuous-batching latency
+      claim: a joining tenant boards the next chunk of a warm program
+      instead of waiting out a cold dispatch);
+    * ``compile_ledger`` — ``continuous_bracket`` compile delta across
+      the WHOLE churning block, pinned <= len(bucket_set): however many
+      tenants come and go, the lane programs never recompile;
+    * ``lane_occupancy``/``lanes_starved``/``chunks`` from the lane
+      gauges, and the fairness bar (no tenant below 80% of its
+      deficit-fair served-cost share) under continuous allocation.
+
+    Budget-gated like every tier (TIER_BUDGETS['serve_continuous']).
+    """
+    import threading
+
+    from hpbandster_tpu import obs
+    from hpbandster_tpu.obs.runtime import get_compile_tracker
+    from hpbandster_tpu.optimizers import BOHB
+    from hpbandster_tpu.parallel import VmapBackend
+    from hpbandster_tpu.serve import ServePool
+    from hpbandster_tpu.workloads.toys import branin_from_vector, branin_space
+
+    total_brackets = n_tenants * brackets_per_tenant
+
+    def p95(xs):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return xs[min(int(math.ceil(0.95 * len(xs))) - 1, len(xs) - 1)]
+
+    def run_fleet(pool, s):
+        """One arrival/departure wave; returns (configs, wall_s,
+        per-tenant submit->first-result latencies)."""
+        done, first, submit = {}, {}, {}
+
+        def drive(i):
+            tenant = f"tenant{i}"
+            ex = pool.executor_for(tenant)
+            orig_finish = ex._finish
+
+            def _finish(job, loss, _orig=orig_finish, t=tenant):
+                if t not in first:
+                    first[t] = time.perf_counter()
+                _orig(job, loss)
+
+            ex._finish = _finish
+            submit[tenant] = time.perf_counter()
+            opt = BOHB(
+                configspace=branin_space(seed=s + i),
+                run_id=f"bench-sc{s}-{i}", tenant_id=tenant,
+                executor=ex, min_budget=1, max_budget=max_budget,
+                eta=3, seed=s + i,
+            )
+            res = opt.run(n_iterations=brackets_per_tenant)
+            opt.shutdown()
+            done[i] = len(res.get_all_runs())
+
+        threads = [
+            threading.Thread(target=drive, args=(i,), daemon=True)
+            for i in range(n_tenants)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+            time.sleep(stagger_s)  # steady arrival, not a thundering herd
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        lat = [
+            first[t] - submit[t] for t in submit if t in first
+        ]
+        return sum(done.values()), dt, lat
+
+    def run_arm(continuous, s):
+        pool = ServePool(
+            VmapBackend(branin_from_vector), branin_space(seed=s),
+            pack_window_s=0.02, continuous=continuous,
+            lane_count=lane_count,
+        )
+        rates, lats = [], []
+        run_fleet(pool, s + 99)  # warmup: programs compile
+        for i in range(repeats):
+            n, dt, lat = run_fleet(pool, s + i)
+            rates.append(n / dt)
+            lats.extend(lat)
+        shares = pool.scheduler.served_cost
+        total_cost = sum(shares.values()) or 1.0
+        fair = 1.0 / max(len(shares), 1)
+        min_ratio = (
+            min(c / total_cost for c in shares.values()) / fair
+            if shares else None
+        )
+        return pool, rates, lats, min_ratio
+
+    reg = obs.get_metrics()
+    led0 = (
+        get_compile_tracker().snapshot()["functions"]
+        .get("continuous_bracket", {}).get("compiles", 0)
+    )
+    chunks0 = int(reg.counter("serve.continuous.chunks").value)
+    pool_c, cont_rates, cont_lats, cont_min_ratio = run_arm(True, seed)
+    led1 = (
+        get_compile_tracker().snapshot()["functions"]
+        .get("continuous_bracket", {}).get("compiles", 0)
+    )
+    _pool_o, shot_rates, shot_lats, _shot_ratio = run_arm(False, seed)
+
+    snap = reg.snapshot()["gauges"]
+    buckets = pool_c.snapshot()["buckets"]
+    cont = _summary(cont_rates)
+    shot = _summary(shot_rates)
+    return {
+        "n_tenants": n_tenants,
+        "lane_count": lane_count,
+        "total_brackets": total_brackets,
+        "median": cont["median"],
+        "iqr": cont["iqr"],
+        "runs_configs_per_s": cont["runs_configs_per_s"],
+        "one_shot": shot,
+        "continuous_vs_one_shot": (
+            round(cont["median"] / shot["median"], 3)
+            if shot["median"] else None
+        ),
+        "p95_admission_to_first_result_s": {
+            "continuous": round(p95(cont_lats), 4) if cont_lats else None,
+            "one_shot": round(p95(shot_lats), 4) if shot_lats else None,
+        },
+        "lane_occupancy": snap.get("serve.lane_occupancy"),
+        "lanes_starved": snap.get("serve.lanes.starved"),
+        "chunks": (
+            int(reg.counter("serve.continuous.chunks").value) - chunks0
+        ),
+        "compile_ledger": {
+            "continuous_bracket_compiles": led1 - led0,
+            "bucket_programs": buckets,
+            "pinned": (led1 - led0) <= max(buckets, 1),
+        },
+        "fairness": {
+            "min_share_ratio": (
+                round(cont_min_ratio, 3)
+                if cont_min_ratio is not None else None
+            ),
+            "ok": (
+                cont_min_ratio is not None and cont_min_ratio >= 0.8
+            ),
+        },
+    }
+
+
 def bench_chaos(n_workers=4, n_iterations=3, seed=0, repeats=3,
                 kill_fraction=0.1, tick_s=0.25, outage_s=0.25,
                 compute_s_per_budget=0.02,
@@ -2104,6 +2269,13 @@ TIER_BUDGETS = {
     # demand must NOT compile per tenant or per pack size, which is
     # exactly the regression a blown ceiling would catch
     "multitenant":     {"max_compiles": 32, "max_transfer_mb": 64},
+    # continuous-batching tier (serve/continuous.py): the whole point is
+    # ONE resident lane program per bucket family across an entire
+    # churning workload — the continuous arm's ledger is pinned to
+    # <= len(bucket_set) inside the tier dict itself; the ceiling here
+    # additionally covers the one-shot comparison arm's megabatch/solo
+    # programs and the KDE propose kernels
+    "serve_continuous": {"max_compiles": 32, "max_transfer_mb": 64},
     # elastic/chaos tier: host sockets + a python objective — the
     # recovery machinery must cost (near) zero device work; a compile
     # appearing here means chaos plumbing leaked onto the device path
@@ -2315,6 +2487,9 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
         multitenant = emit("multitenant", _run_tier(
             errors, "multitenant", bench_multitenant,
             n_tenants=4, repeats=repeats))
+        serve_continuous = emit("serve_continuous", _run_tier(
+            errors, "serve_continuous", bench_serve_continuous,
+            n_tenants=4, lane_count=2, repeats=repeats))
         chaos = emit("chaos", _run_tier(
             errors, "chaos", bench_chaos,
             n_workers=2, n_iterations=1, repeats=repeats))
@@ -2511,6 +2686,15 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
                            repeats=repeats))
             if selected("multitenant") else dict(NOT_SELECTED)
         )
+        # continuous-batching tier: toy objective + host threads like
+        # multitenant (the measurement is the RESIDENT-program machinery,
+        # not the chip), so it runs on the fallback path too
+        serve_continuous = (
+            emit("serve_continuous",
+                 _run_tier(errors, "serve_continuous",
+                           bench_serve_continuous, repeats=repeats))
+            if selected("serve_continuous") else dict(NOT_SELECTED)
+        )
         # elastic-fleet tier: host sockets + a python objective like the
         # rpc tier, so it measures anywhere (fallback runs included) —
         # the throughput-retention claim in docs/fault_tolerance.md must
@@ -2648,6 +2832,7 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
             "chunked_compile_static_vs_dynamic": chunked,
             "chunked10k_at_scale_36_brackets_1_729": chunked10k,
             "multitenant_serving_16_tenants": multitenant,
+            "serve_continuous_batching": serve_continuous,
             "chaos_churn_10pct": chaos,
             "async_straggler_promotion": async_straggler,
             "obs_overhead_no_sink": obs_overhead,

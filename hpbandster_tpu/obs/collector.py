@@ -149,6 +149,37 @@ def tenant_counters(
     return out
 
 
+def lane_gauges(gauges: Mapping[str, Any]) -> Dict[str, Any]:
+    """``{field: value}`` for the continuous-batching lane gauges
+    (``serve/continuous.py``): ``total``/``occupied``/``starved`` from
+    ``serve.lanes.*``, ``occupancy`` from ``serve.lane_occupancy``, and
+    ``warm_age_s`` = the OLDEST family's program-warm age (plus
+    ``families``, the resident-program count) from the
+    ``serve.family.<f>.warm_age_s`` family. THE one parser of these
+    names — the ``top`` lane line and ``watch --snapshot``'s lanes part
+    both read through it."""
+    out: Dict[str, Any] = {}
+    ages = []
+    for name, value in (gauges or {}).items():
+        if not isinstance(name, str):
+            continue
+        v = _num(value)
+        if v is None:
+            continue
+        if name == "serve.lane_occupancy":
+            out["occupancy"] = v
+        elif name.startswith("serve.lanes."):
+            out[name[len("serve.lanes."):]] = v
+        elif name.startswith("serve.family.") and name.endswith(
+            ".warm_age_s"
+        ):
+            ages.append(v)
+    if ages:
+        out["warm_age_s"] = max(ages)
+        out["families"] = len(ages)
+    return out
+
+
 def _endpoint_row(snap: Dict[str, Any]) -> Dict[str, Any]:
     """Distill one ``obs_snapshot`` into the per-endpoint series row: the
     handful of fields fleet aggregation and ``top`` actually read."""
@@ -192,6 +223,10 @@ def _endpoint_row(snap: Dict[str, Any]) -> Dict[str, Any]:
     # device-telemetry line and watch --snapshot appends per row (ONE
     # gauge-name parser, shared with the watch renderer)
     device_metrics = device_metric_fields(gauges)
+    # continuous-batching lane census (serve/continuous.py): occupancy,
+    # starved lanes and program-warm age — the `top` lane line and the
+    # watch lanes part (ONE parser, lane_gauges)
+    lanes = lane_gauges(gauges)
     return {
         "component": snap.get("component"),
         "uptime_s": _num(snap.get("uptime_s")),
@@ -206,6 +241,7 @@ def _endpoint_row(snap: Dict[str, Any]) -> Dict[str, Any]:
         "devices": dev_rows,
         "sweep_devices": sweep_devices,
         "device_metrics": device_metrics,
+        "lanes": lanes,
         "alerts_total": _num(alerts.get("total")),
         "tenants": tenants,
     }
@@ -894,6 +930,29 @@ def format_fleet_table(
                 " ({:.2f}%)".format(100.0 * crashes / evals)
                 if evals else "",
                 rounds, fits,
+            )
+        )
+    # continuous-batching lane line (serve/continuous.py gauges):
+    # present only when an endpoint serves resident lane programs, so
+    # lane-free fleets render exactly as before
+    lane_rows = [
+        row.get("lanes")
+        for row in (sample.get("endpoints") or {}).values()
+        if row.get("lanes")
+    ]
+    if lane_rows:
+        total = sum(int(r.get("total", 0)) for r in lane_rows)
+        occupied = sum(int(r.get("occupied", 0)) for r in lane_rows)
+        starved = sum(int(r.get("starved", 0)) for r in lane_rows)
+        ages = [
+            r.get("warm_age_s") for r in lane_rows
+            if isinstance(r.get("warm_age_s"), (int, float))
+        ]
+        lines.append(
+            "       lanes: occupied={}/{}  starved={}  warm_age_s={}"
+            .format(
+                occupied, total, starved,
+                _fmt(max(ages), 1) if ages else "-",
             )
         )
     lines.append("")

@@ -66,6 +66,8 @@ __all__ = [
     "CHAOS_FAULT",
     "SWEEP_INCUMBENT",
     "DEVICE_TELEMETRY",
+    "LANE_ASSIGNED",
+    "LANE_RELEASED",
 ]
 
 logger = logging.getLogger("hpbandster_tpu.obs")
@@ -125,6 +127,15 @@ SWEEP_INCUMBENT = "sweep_incumbent"
 #: sweep's final d2h, so fused/resident sweeps feed the obs pipeline
 #: without surfacing per-job events
 DEVICE_TELEMETRY = "device_telemetry"
+#: continuous-batching lane lifecycle (serve/continuous.py): a mesh lane
+#: of a resident bucket-family program changed owner — ``lane_assigned``
+#: when a lane takes a NEW owner at a chunk boundary (carries
+#: ``lane``/``family``/``tenant``; warm re-boardings are silent —
+#: ownership is sticky, so the journal records changes, not every
+#: chunk), and ``lane_released`` when the owner departs and the lane
+#: returns to the free pool
+LANE_ASSIGNED = "lane_assigned"
+LANE_RELEASED = "lane_released"
 
 #: the core vocabulary (docs/observability.md "Event schema"). emit() also
 #: accepts names outside this set — subsystems may add their own (span
@@ -135,7 +146,8 @@ EVENT_TYPES = frozenset({
     RPC_RETRY, RESULT_DELIVERED, CHECKPOINT_WRITTEN, UNKNOWN_RESULT,
     CONFIG_SAMPLED, PROMOTION_DECISION, ALERT, XLA_COMPILE, FLEET_SAMPLE,
     JOB_REQUEUED, RESULT_REPLAYED, DUPLICATE_RESULT, WORKER_QUARANTINED,
-    CHAOS_FAULT, SWEEP_INCUMBENT, DEVICE_TELEMETRY,
+    CHAOS_FAULT, SWEEP_INCUMBENT, DEVICE_TELEMETRY, LANE_ASSIGNED,
+    LANE_RELEASED,
 })
 
 #: process-wide kill switch (hpbandster_tpu.obs.set_enabled)

@@ -654,6 +654,30 @@ def _snapshot_tenant_part(
     ) + (",..." if len(done) > 4 else "") + ")"
 
 
+def _snapshot_lane_part(snap: Dict[str, Any]) -> str:
+    """The continuous-batching slice of one watch line: lane occupancy,
+    starved-lane count and program-warm age (``serve/continuous.py``
+    gauges, read through the collector's one parser). No lanes, no part
+    — lane-free processes' lines stay exactly as they were."""
+    from hpbandster_tpu.obs.collector import lane_gauges
+
+    lanes = lane_gauges((snap.get("metrics") or {}).get("gauges"))
+    if not lanes:
+        return ""
+    parts = []
+    if "occupied" in lanes or "total" in lanes:
+        parts.append(
+            "occ=%d/%d" % (
+                int(lanes.get("occupied", 0)), int(lanes.get("total", 0))
+            )
+        )
+    if "starved" in lanes:
+        parts.append(f"starved={int(lanes['starved'])}")
+    if "warm_age_s" in lanes:
+        parts.append(f"warm_age={lanes['warm_age_s']:.1f}s")
+    return (" lanes: " + " ".join(parts)) if parts else ""
+
+
 def _snapshot_device_part(snap: Dict[str, Any]) -> str:
     """The device-metrics-plane slice of one watch line: the last
     sweep's decoded in-trace counters (``sweep.device_metrics.*``
@@ -698,6 +722,7 @@ def _snapshot_status_line(
         f"alerts={alerts.get('total', 0)}"
         + (f" latency: {lat_part}" if lat_part else "")
         + _snapshot_tenant_part(snap, tenant)
+        + _snapshot_lane_part(snap)
         + _snapshot_device_part(snap)
         + _snapshot_runtime_part(snap)
     )

@@ -55,7 +55,10 @@ __all__ = [
     "bucketed_stage_telemetry",
     "fused_sh_bracket_bucketed",
     "fused_sh_bracket_bucketed_packed",
+    "fused_sh_bracket_bucketed_packed_carry",
     "make_bucketed_bracket_fn",
+    "member_counts_for",
+    "member_telemetry_record",
     "precompile_buckets",
     "slice_member_stages",
 ]
@@ -283,11 +286,43 @@ def fused_sh_bracket_bucketed(
     return out
 
 
+def _lane_stages(eval_fn: Callable, bucket: BucketPlan):
+    """ONE definition of a packed program's lane body: run the bucketed
+    bracket and flat-concatenate its stages — shared by the uncarried
+    and carried packed kernels (and their telemetry variants), so a
+    future change to the lane semantics cannot diverge between the
+    compiled programs."""
+    import jax.numpy as jnp
+
+    def run(vecs, cnts):
+        stages = fused_sh_bracket_bucketed(eval_fn, vecs, cnts, bucket)
+        return (
+            stages,
+            jnp.concatenate([s[0] for s in stages]),
+            jnp.concatenate([s[1] for s in stages]),
+        )
+
+    return run
+
+
+def _lane_telemetry(stages, cnts, edges):
+    """Per-lane telemetry stack: ``(i32[depth, n_bins], i32[depth])``
+    from :func:`bucketed_stage_telemetry` (padding-masked)."""
+    import jax.numpy as jnp
+
+    tel = bucketed_stage_telemetry(stages, cnts, edges)
+    return (
+        jnp.stack([h for h, _ in tel]),
+        jnp.stack([c for _, c in tel]),
+    )
+
+
 def fused_sh_bracket_bucketed_packed(
     eval_fn: Callable,
     vectors,
     counts,
     bucket: BucketPlan,
+    telemetry_edges=None,
 ):
     """A LANE-PACKED stack of bucketed brackets, traceable under ``jit``.
 
@@ -300,7 +335,9 @@ def fused_sh_bracket_bucketed_packed(
     (pinned by ``tests/test_serve.py``). Returns the packed per-lane
     ``(i32[P, sum(widths)], f32[P, sum(widths)])`` pair — the same
     flat-concatenated layout the solo ``_BucketRunner`` ships, with a
-    leading lane axis.
+    leading lane axis. With ``telemetry_edges`` (the device-metrics bin
+    schema) the return gains per-lane ``(hist i32[P, depth, n_bins],
+    crashes i32[P, depth])`` from :func:`bucketed_stage_telemetry`.
 
     A lane whose counts are all zero is pure padding: every stage carries
     the identity slice and its rows are evaluated (bounded waste, exactly
@@ -309,14 +346,85 @@ def fused_sh_bracket_bucketed_packed(
     import jax
     import jax.numpy as jnp
 
+    body = _lane_stages(eval_fn, bucket)
+
     def one_lane(vecs, cnts):
-        stages = fused_sh_bracket_bucketed(eval_fn, vecs, cnts, bucket)
-        return (
-            jnp.concatenate([s[0] for s in stages]),
-            jnp.concatenate([s[1] for s in stages]),
-        )
+        stages, idx, loss = body(vecs, cnts)
+        if telemetry_edges is None:
+            return idx, loss
+        hist, crashes = _lane_telemetry(stages, cnts, telemetry_edges)
+        return idx, loss, hist, crashes
 
     return jax.vmap(one_lane)(vectors, jnp.asarray(counts, jnp.int32))
+
+
+def fused_sh_bracket_bucketed_packed_carry(
+    eval_fn: Callable,
+    vectors,
+    counts,
+    carry,
+    reset,
+    bucket: BucketPlan,
+    telemetry_edges=None,
+):
+    """The CARRIED lane-packed kernel — the continuous-batching tier's
+    device program (``serve/continuous.py``).
+
+    Identical lane semantics to :func:`fused_sh_bracket_bucketed_packed`
+    (each lane's promotions are bit-identical to a solo dispatch,
+    pinned), plus a per-lane incumbent state threaded device-to-device
+    across chunk dispatches the way the resident sweep threads its obs
+    state (``ops/sweep.py``):
+
+    * ``carry`` is ``f32[P]`` in RANK space
+      (:func:`~hpbandster_tpu.ops.sweep.init_lane_state`): a real loss
+      is itself, crashed-only is the shared crash-rank constant, and
+      ``+inf`` means the lane has observed nothing;
+    * ``reset`` is ``bool[P]``: True re-initializes the lane's carry
+      BEFORE this chunk folds in (a lane whose owner changed at the
+      chunk boundary must not leak the previous tenant's incumbent);
+    * each lane folds ``min(carry, best final-stage loss)`` where NaN
+      rows rank at the crash constant and rows past the lane's traced
+      final count are ``+inf`` — a zero-count (masked-empty) lane folds
+      ``+inf`` and its carry passes through untouched.
+
+    Returns ``((i32[P, sum(widths)], f32[P, sum(widths)]), f32[P])`` —
+    the packed per-lane stage pair and the updated carry, which the
+    caller keeps ON DEVICE between chunks (the whole point: tenant churn
+    never re-uploads or re-compiles, and the incumbent trail needs no
+    per-chunk d2h). With ``telemetry_edges`` the return gains a third
+    element: per-lane ``(hist i32[P, depth, n_bins],
+    crashes i32[P, depth])`` — the device metrics plane riding the same
+    dispatch (padding lanes mask to zero).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    counts = jnp.asarray(counts, jnp.int32)
+    carry = jnp.asarray(carry, jnp.float32)
+    reset = jnp.asarray(reset, jnp.bool_)
+    body = _lane_stages(eval_fn, bucket)
+
+    def one_lane(vecs, cnts, c_in, rst):
+        stages, idx, loss = body(vecs, cnts)
+        _f_idx, f_loss = stages[-1]
+        w_last = bucket.widths[-1]
+        valid = jnp.arange(w_last, dtype=jnp.int32) < cnts[-1]
+        rank = jnp.where(jnp.isnan(f_loss), jnp.float32(_CRASH_RANK), f_loss)
+        rank = jnp.where(valid, rank, jnp.inf)
+        base = jnp.where(rst, jnp.inf, c_in)
+        new_c = jnp.minimum(base, jnp.min(rank))
+        if telemetry_edges is None:
+            return idx, loss, new_c
+        hist, crashes = _lane_telemetry(stages, cnts, telemetry_edges)
+        return idx, loss, new_c, hist, crashes
+
+    out = jax.vmap(one_lane)(vectors, counts, carry, reset)
+    if telemetry_edges is None:
+        idx, loss, new_carry = out
+        return (idx, loss), new_carry
+    idx, loss, new_carry, hist, crashes = out
+    return (idx, loss), new_carry, (hist, crashes)
 
 
 def bucketed_stage_telemetry(stages, counts, edges):
@@ -363,9 +471,95 @@ def slice_member_stages(
     return out
 
 
+def member_counts_for(
+    bucket: BucketPlan, plan: BracketPlan, entry: int
+) -> np.ndarray:
+    """One member bracket's entry-aligned traced-count vector
+    (``i32[bucket.depth]``, zeros for pre-entry stages) — the ONE
+    definition of the counts layout every dispatcher builds."""
+    counts = np.zeros(bucket.depth, np.int32)
+    for s, k in enumerate(plan.num_configs):
+        counts[entry + s] = int(k)
+    return counts
+
+
+def member_telemetry_record(hist, crashes, counts, budgets, stages):
+    """One member bracket's fetched in-trace telemetry -> the decoded
+    ``device_telemetry`` record (``obs/device_metrics.py`` schema).
+
+    ``hist``/``crashes`` are the :func:`bucketed_stage_telemetry` outputs
+    for this member's dispatch (or its lane of a packed dispatch),
+    bucket-depth rows; ``counts`` the member's entry-aligned traced
+    counts; ``budgets`` the bucket's budgets; ``stages`` the member's
+    TRUE-shape per-stage ``(idx, losses)`` (for the best-final fold —
+    already fetched, no extra device work). Returns None for an all-zero
+    (padding) lane. The record shape matches what the fused drivers
+    journal, so ``summarize``/``report``/anomaly readers see one schema
+    whichever executor produced it.
+    """
+    from types import SimpleNamespace
+
+    from hpbandster_tpu.obs.device_metrics import decode_device_metrics
+
+    counts = np.asarray(counts, np.int64)
+    nonzero = np.nonzero(counts)[0]
+    if nonzero.size == 0:
+        return None
+    entry = int(nonzero[0])
+    member_counts = tuple(int(c) for c in counts[entry:])
+    member_budgets = tuple(float(b) for b in budgets[entry:])
+    n_stages = len(member_counts)
+    hist_m = np.asarray(hist)[entry:entry + n_stages]
+    crash_m = np.asarray(crashes)[entry:entry + n_stages]
+    # SH promotions are exactly the next stage's traced count (the rank
+    # mask always fills it: counts are non-increasing and crashed rows
+    # still rank); the final rung promotes nobody
+    promos = np.array(list(member_counts[1:]) + [0], np.int64)
+    final_losses = np.asarray(stages[-1][1], np.float32)[: member_counts[-1]]
+    finite = final_losses[~np.isnan(final_losses)]
+    best = float(finite.min()) if finite.size else float("nan")
+    metrics = SimpleNamespace(
+        loss_hist=hist_m[None, :, :],
+        evals=np.array([member_counts], np.int64),
+        crashes=crash_m[None, :],
+        promotions=promos[None, :],
+        model_fits=np.zeros((1,), np.int64),
+        best_final=np.array([best], np.float32),
+    )
+    return decode_device_metrics(
+        metrics, plans=[(member_counts, member_budgets)]
+    )
+
+
+class _TelemetryPacked(NamedTuple):
+    """A telemetry-carrying dispatch handle: the compiled output tuple
+    plus the host counts the decode needs (callers treat dispatch
+    results as opaque, so the handle rides through their fetch
+    plumbing untouched)."""
+
+    out: Tuple
+    counts: np.ndarray
+
+
+def _publish_member_telemetry(hist, crashes, counts, budgets, stages) -> None:
+    """Decode one member's fetched telemetry and hand it to the obs
+    pipeline (gauges + ``device_telemetry`` journal record) — the shared
+    tail of the solo and packed unpack paths."""
+    from hpbandster_tpu.obs.device_metrics import (
+        emit_device_telemetry,
+        publish_device_metrics,
+    )
+
+    rec = member_telemetry_record(hist, crashes, counts, budgets, stages)
+    if rec is not None:
+        publish_device_metrics(rec)
+        emit_device_telemetry(rec)
+
+
 #: process-wide compiled-bucket cache — same policy as ops.fused's
-#: _FUSED_FN_CACHE: a (objective, bucket, mesh) combination compiles once
-#: per process, bounded so throwaway closures cannot pin executables
+#: _FUSED_FN_CACHE: a (objective, bucket, mesh, telemetry-flag)
+#: combination compiles once per process, bounded so throwaway closures
+#: cannot pin executables
 _BUCKET_FN_CACHE: LRUCache = LRUCache(maxsize=64)
 
 
@@ -381,15 +575,34 @@ class _BucketRunner:
     untracked-by-AOT cache entry).
     """
 
-    def __init__(self, eval_fn, bucket: BucketPlan, mesh=None, axis="config"):
+    def __init__(self, eval_fn, bucket: BucketPlan, mesh=None, axis="config",
+                 device_metrics: Optional[bool] = None):
+        from hpbandster_tpu.obs.device_metrics import device_metrics_default
         from hpbandster_tpu.obs.runtime import tracked_jit
 
         self.bucket = bucket
         self.mesh = mesh
         self.axis = axis
+        #: in-trace telemetry (obs/device_metrics.py): the compiled
+        #: program additionally returns per-stage histograms + crash
+        #: counts (bucketed_stage_telemetry) and every unpack emits the
+        #: decoded device_telemetry record — the bucketed/megabatch
+        #: executors' join onto the device metrics plane. Resolved HERE
+        #: (not at dispatch) because the flag changes the program.
+        self.device_metrics = (
+            device_metrics_default() if device_metrics is None
+            else bool(device_metrics)
+        )
         self._lock = threading.Lock()
         self._compiled = None
         self._dim: Optional[int] = None
+        # the bin schema is a host constant burned into the trace —
+        # resolved OUTSIDE the traced closure (obs-emit-in-jit contract)
+        dm_edges = None
+        if self.device_metrics:
+            from hpbandster_tpu.obs.device_metrics import bin_edges
+
+            dm_edges = bin_edges().astype(np.float32)
 
         def bracket(vectors, counts):
             stages = fused_sh_bracket_bucketed(
@@ -397,9 +610,16 @@ class _BucketRunner:
             )
             import jax.numpy as jnp
 
-            return (
+            out = (
                 jnp.concatenate([s[0] for s in stages]),
                 jnp.concatenate([s[1] for s in stages]),
+            )
+            if dm_edges is None:
+                return out
+            tel = bucketed_stage_telemetry(stages, counts, dm_edges)
+            return out + (
+                jnp.stack([h for h, _ in tel]),
+                jnp.stack([c for _, c in tel]),
             )
 
         jit_kwargs: Dict = {
@@ -471,6 +691,7 @@ class _BucketRunner:
             )
         compiled = self.ensure_compiled(vectors.shape[1])
         note_transfer("h2d", vectors.nbytes + counts.nbytes, buffers=2)
+        counts_host = np.asarray(counts)
         if self.mesh is not None:
             import jax
             from jax.sharding import NamedSharding, PartitionSpec
@@ -478,37 +699,53 @@ class _BucketRunner:
             shard = NamedSharding(self.mesh, PartitionSpec(self.axis))
             rep = NamedSharding(self.mesh, PartitionSpec())
             vecs_host = vectors
-            counts_host = counts
             vectors = jax.make_array_from_callback(
                 vecs_host.shape, shard, lambda idx: vecs_host[idx]
             )
             counts = jax.make_array_from_callback(
                 counts_host.shape, rep, lambda idx: counts_host[idx]
             )
-        return compiled(vectors, counts)
+        out = compiled(vectors, counts)
+        if self.device_metrics:
+            # the counts ride the handle so unpack can decode the
+            # telemetry against the member's true rung layout
+            return _TelemetryPacked(out, counts_host)
+        return out
 
     def unpack(self, packed) -> List[Tuple[np.ndarray, np.ndarray]]:
         """Blocking fetch of a dispatch's packed pair, cut back into
-        per-stage (idx, losses) at bucket widths."""
+        per-stage (idx, losses) at bucket widths. A telemetry-carrying
+        dispatch (``device_metrics=True``) additionally decodes the
+        in-trace histograms/crash counts into a ``device_telemetry``
+        record, publishes the gauges, and journals the event — the
+        bucketed executor tier's join onto the device metrics plane."""
         import jax
 
         from hpbandster_tpu.obs.runtime import note_transfer
 
-        idx_flat, loss_flat = jax.device_get(tuple(packed))
-        note_transfer("d2h", idx_flat.nbytes + loss_flat.nbytes, buffers=2)
+        counts_host = None
+        if isinstance(packed, _TelemetryPacked):
+            packed, counts_host = packed
+        fetched = jax.device_get(tuple(packed))
+        note_transfer(
+            "d2h", sum(int(a.nbytes) for a in fetched), buffers=len(fetched)
+        )
+        idx_flat, loss_flat = fetched[0], fetched[1]
         out, off = [], 0
         for w in self.bucket.widths:
             out.append((idx_flat[off:off + w], loss_flat[off:off + w]))
             off += w
+        if counts_host is not None and len(fetched) == 4:
+            _publish_member_telemetry(
+                fetched[2], fetched[3], counts_host, self.bucket.budgets, out
+            )
         return out
 
     def run_member(self, vectors: np.ndarray, plan: BracketPlan, entry: int):
         """Dispatch + fetch one member bracket, returning its TRUE-shape
         per-stage ``(indices, losses)`` — the drop-in equivalent of a
         ``make_fused_bracket_fn`` runner call."""
-        counts = np.zeros(self.bucket.depth, np.int32)
-        for s, k in enumerate(plan.num_configs):
-            counts[entry + s] = int(k)
+        counts = member_counts_for(self.bucket, plan, entry)
         packed = self.dispatch(np.asarray(vectors, np.float32), counts)
         return slice_member_stages(self.unpack(packed), plan, entry)
 
@@ -518,12 +755,23 @@ def make_bucketed_bracket_fn(
     bucket: BucketPlan,
     mesh=None,
     axis: str = "config",
+    device_metrics: Optional[bool] = None,
 ) -> _BucketRunner:
-    """The (process-cached) runner for one bucket program."""
-    key = (eval_fn, bucket, mesh, axis)
+    """The (process-cached) runner for one bucket program. The telemetry
+    flag resolves BEFORE the cache key (like the fused drivers'
+    ``_sweep_key``): a mid-process ``HPB_DEVICE_METRICS`` flip misses the
+    cache instead of silently serving the other program."""
+    from hpbandster_tpu.obs.device_metrics import device_metrics_default
+
+    if device_metrics is None:
+        device_metrics = device_metrics_default()
+    key = (eval_fn, bucket, mesh, axis, bool(device_metrics))
     runner = _BUCKET_FN_CACHE.get(key)
     if runner is None:
-        runner = _BucketRunner(eval_fn, bucket, mesh=mesh, axis=axis)
+        runner = _BucketRunner(
+            eval_fn, bucket, mesh=mesh, axis=axis,
+            device_metrics=device_metrics,
+        )
         _BUCKET_FN_CACHE[key] = runner
     return runner
 

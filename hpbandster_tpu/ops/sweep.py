@@ -61,7 +61,8 @@ __all__ = ["SpaceCodec", "build_space_codec", "quantize_unit", "random_unit",
            "SweepBracketOutput", "SweepIncumbent", "plan_additions",
            "pow2_capacities", "ResidentSweepOutputs", "resident_rotation",
            "unstack_resident_outputs", "DeviceMetrics",
-           "init_device_metrics"]
+           "init_device_metrics", "init_lane_state", "decode_lane_state",
+           "sweep_donation_safe"]
 
 
 def pow2_capacities(counts: dict, floor: int = 256) -> dict:
@@ -679,6 +680,45 @@ def init_device_metrics(n_brackets: int, max_rungs: int, n_bins: int) -> DeviceM
         model_fits=jnp.zeros((n_brackets,), jnp.int32),
         best_final=jnp.full((n_brackets,), jnp.nan, jnp.float32),
     )
+
+
+def init_lane_state(n_lanes: int) -> jax.Array:
+    """Fresh per-lane incumbent carry for a continuous-batching program
+    (``serve/continuous.py`` over ``ops.buckets.
+    fused_sh_bracket_bucketed_packed_carry``): one RANK-SPACE f32 per
+    lane, ``+inf`` = the lane has observed nothing yet.
+
+    Rank space is the incumbent fold's ordering domain (the same
+    convention as the resident sweep's incumbent carry): a real loss is
+    itself, a crashed (NaN) evaluation is ``_CRASH_RANK`` (behind every
+    real loss, ahead of emptiness), and ``+inf`` is untouched — so the
+    in-trace fold is one ``minimum`` with no NaN special-casing, and the
+    carry threads device-to-device across chunks exactly like the
+    resident sweep's obs state. :func:`decode_lane_state` is the host
+    twin that maps rank space back to loss-or-None.
+    """
+    return jnp.full((int(n_lanes),), jnp.inf, jnp.float32)
+
+
+def decode_lane_state(carry) -> List[Optional[float]]:
+    """Host decode of one rank-space lane carry: per lane, the running
+    incumbent loss, ``float('nan')`` for a lane that has only ever
+    crashed, or None for a lane that has observed nothing."""
+    out: List[Optional[float]] = []
+    for v in np.asarray(carry, np.float32):
+        v = float(v)
+        if v == float("inf"):
+            out.append(None)
+        elif v >= float(_CRASH_RANK):
+            out.append(float("nan"))
+        else:
+            out.append(v)
+    return out
+
+
+#: public name for the donation gate (serve/continuous.py threads its
+#: lane carry device-to-device and donates under the same CPU caveat)
+sweep_donation_safe = _sweep_donation_safe
 
 
 def resident_rotation(plans: Sequence[BracketPlan]) -> Tuple[int, int, int]:

@@ -250,9 +250,9 @@ class BatchedExecutor:
             bucketed = self._bucket_runner_for(info)
             if bucketed is not None:
                 runner, plan, entry = bucketed
-                counts = np.zeros(runner.bucket.depth, np.int32)
-                for s, k in enumerate(plan.num_configs):
-                    counts[entry + s] = int(k)
+                from hpbandster_tpu.ops.buckets import member_counts_for
+
+                counts = member_counts_for(runner.bucket, plan, entry)
                 try:
                     with obs.span(
                         "fused_dispatch", iteration=iteration,

@@ -11,9 +11,15 @@ fleet, not one giant sweep"). The pieces, bottom-up:
   bucket-compatible brackets from different tenants lane-pack into ONE
   ``fused_sh_bracket_bucketed_packed`` dispatch (``ops/buckets.py``),
   results demuxed back per tenant, bit-identical to solo dispatch;
+* :mod:`~hpbandster_tpu.serve.continuous` — continuous batching:
+  a RESIDENT lane-packed program per bucket family
+  (:class:`ContinuousRunner`) that runs chunks in a loop with a
+  device-resident per-lane incumbent carry; tenants join and leave at
+  chunk boundaries, the program compiles once and never goes cold
+  (``ServePool(continuous=True)``);
 * :mod:`~hpbandster_tpu.serve.pool` — :class:`ServePool`: per-tenant
-  executor facades feeding fair-scheduled, megabatched rounds against
-  one shared backend;
+  executor facades feeding fair-scheduled, megabatched (or
+  continuous-batched) rounds against one shared backend;
 * :mod:`~hpbandster_tpu.serve.session` — sweep specs, per-tenant
   sessions with WARM MODELS (a returning tenant's KDE resumes from its
   previous Result via ``core/warmstart.py``), and the per-sweep
@@ -30,6 +36,11 @@ journals stay byte-identical (no context, no field). See
 docs/serving.md.
 """
 
+from hpbandster_tpu.serve.continuous import (  # noqa: F401
+    ContinuousRunner,
+    LaneAllocator,
+    make_lane_mesh,
+)
 from hpbandster_tpu.serve.frontend import ServeFrontend  # noqa: F401
 from hpbandster_tpu.serve.megabatch import (  # noqa: F401
     MegaRunner,
@@ -53,6 +64,9 @@ from hpbandster_tpu.serve.session import (  # noqa: F401
 )
 
 __all__ = [
+    "ContinuousRunner",
+    "LaneAllocator",
+    "make_lane_mesh",
     "ServeFrontend",
     "ServePool",
     "SweepSpec",

@@ -15,6 +15,13 @@ one :class:`~hpbandster_tpu.parallel.rpc.RPCServer` exposing
   sweeps: the id namespace is checked against the caller's tenant.
 * ``tenant_quota(tenant)`` — current quota + headroom (what admission
   would say right now).
+
+With ``auth_tokens={tenant: secret}`` (or :meth:`ServeFrontend.
+set_token`) the three tenant-facing RPCs above additionally require the
+caller's ``token=``, validated with a constant-time compare — tenant
+ids stop being self-reported. Open mode (no table) is unchanged.
+Secrets never leave the frontend: not logged, not journaled, not in
+metric names (docs/serving.md "Tenant authentication").
 * ``pool_snapshot()`` — operator view: tenants, queues, rounds, buckets.
 * the standard :class:`~hpbandster_tpu.obs.health.HealthEndpoint` trio
   (``obs_snapshot`` / ``metrics_text`` / profiling), so the frontend is
@@ -31,6 +38,7 @@ journal. Per-tenant gauges (``serve.tenant.<t>.quota_headroom``,
 
 from __future__ import annotations
 
+import hmac
 import logging
 import threading
 import time
@@ -57,6 +65,7 @@ class ServeFrontend:
         port: int = 0,
         store: Optional[TenantStore] = None,
         persist_dir: Optional[str] = None,
+        auth_tokens: Optional[Dict[str, str]] = None,
         logger: Optional[logging.Logger] = None,
     ):
         from hpbandster_tpu.parallel.rpc import RPCServer
@@ -66,6 +75,18 @@ class ServeFrontend:
         # KDE each tenant paid to learn) survives frontend restarts —
         # see TenantStore and docs/fault_tolerance.md "Serving tier"
         self.store = store or TenantStore(persist_dir=persist_dir)
+        # optional per-tenant shared-secret authn (docs/serving.md
+        # "Tenant authentication"): with a token table configured,
+        # submit_sweep / sweep_status / sweep_result require the
+        # caller's token and reject-with-reason otherwise — tenant ids
+        # stop being self-reported. None = open mode (the PR 8
+        # behavior, unchanged). Secrets live ONLY here: they are
+        # compared constant-time, never logged, never journaled, and
+        # never ride an obs event or metric name.
+        self._auth_tokens = (
+            {str(t): str(s) for t, s in auth_tokens.items()}
+            if auth_tokens is not None else None
+        )
         self.logger = logger or logging.getLogger("hpbandster_tpu.serve")
         self._lock = threading.Lock()
         #: serializes admission-check -> registration: the RPC server is
@@ -116,12 +137,50 @@ class ServeFrontend:
                 states[r["state"]] = states.get(r["state"], 0) + 1
         return {"sweeps": states, "pool": self.pool.snapshot()}
 
+    # ---------------------------------------------------------------- authn
+    def set_token(self, tenant: str, secret: str) -> None:
+        """Register (or rotate) one tenant's shared secret. First call
+        on an open-mode frontend switches authentication ON for every
+        guarded RPC."""
+        if self._auth_tokens is None:
+            self._auth_tokens = {}
+        self._auth_tokens[str(tenant)] = str(secret)
+
+    def _authenticate(self, tenant: Any, token: Any) -> Optional[str]:
+        """None when the caller may act as ``tenant``, else the reject
+        reason. Constant-time compare (``hmac.compare_digest``); an
+        unknown tenant still burns one compare so a probe cannot tell
+        "unknown tenant" from "wrong token" by timing. The token itself
+        is never logged or journaled — reasons carry no secret
+        material."""
+        if self._auth_tokens is None:
+            return None
+        expected = self._auth_tokens.get(
+            tenant if isinstance(tenant, str) else ""
+        )
+        provided = token if isinstance(token, str) else ""
+        ok = hmac.compare_digest(
+            (expected if expected is not None else uuid.uuid4().hex
+             ).encode("utf-8"),
+            provided.encode("utf-8"),
+        )
+        if expected is None or not ok:
+            return f"authentication failed for tenant {tenant!r}"
+        return None
+
     # ------------------------------------------------------------- RPC body
     def submit_sweep(
-        self, tenant: str, spec: Optional[Dict[str, Any]] = None
+        self, tenant: str, spec: Optional[Dict[str, Any]] = None,
+        token: Optional[str] = None,
     ) -> Dict[str, Any]:
         if not isinstance(tenant, str) or not tenant:
             return {"accepted": False, "reason": "tenant must be a non-empty string"}
+        denied = self._authenticate(tenant, token)
+        if denied is not None:
+            obs.get_metrics().counter(
+                f"serve.tenant.{tenant}.auth_rejected"
+            ).inc()
+            return {"accepted": False, "reason": denied}
         try:
             sweep_spec = SweepSpec.from_dict(spec or {})
         except (ValueError, TypeError) as e:
@@ -271,7 +330,18 @@ class ServeFrontend:
             return None
         return run
 
-    def sweep_status(self, tenant: str, sweep_id: str) -> Dict[str, Any]:
+    def sweep_status(
+        self, tenant: str, sweep_id: str, token: Optional[str] = None
+    ) -> Dict[str, Any]:
+        denied = self._authenticate(tenant, token)
+        if denied is not None:
+            # counted like submit rejects: status/result probes are the
+            # cheap brute-force surface, and the counter is the one
+            # authn metric operators watch
+            obs.get_metrics().counter(
+                f"serve.tenant.{tenant}.auth_rejected"
+            ).inc()
+            return {"error": denied}
         run = self._run_for(tenant, sweep_id)
         if run is None:
             return {"error": f"unknown sweep {sweep_id!r}"}
@@ -286,7 +356,15 @@ class ServeFrontend:
         out.update(master.progress() if master is not None else final)
         return out
 
-    def sweep_result(self, tenant: str, sweep_id: str) -> Dict[str, Any]:
+    def sweep_result(
+        self, tenant: str, sweep_id: str, token: Optional[str] = None
+    ) -> Dict[str, Any]:
+        denied = self._authenticate(tenant, token)
+        if denied is not None:
+            obs.get_metrics().counter(
+                f"serve.tenant.{tenant}.auth_rejected"
+            ).inc()
+            return {"error": denied}
         run = self._run_for(tenant, sweep_id)
         if run is None:
             return {"error": f"unknown sweep {sweep_id!r}"}

@@ -42,6 +42,8 @@ from hpbandster_tpu.ops.bracket import BracketPlan
 from hpbandster_tpu.ops.buckets import (
     BucketPlan,
     fused_sh_bracket_bucketed_packed,
+    member_counts_for,
+    member_telemetry_record,
     slice_member_stages,
 )
 from hpbandster_tpu.utils.lru import LRUCache
@@ -106,7 +108,9 @@ class MegaRunner:
         pack_width: int = 8,
         mesh=None,
         axis: str = "config",
+        device_metrics: Optional[bool] = None,
     ):
+        from hpbandster_tpu.obs.device_metrics import device_metrics_default
         from hpbandster_tpu.obs.runtime import tracked_jit
 
         if pack_width < 1:
@@ -115,13 +119,28 @@ class MegaRunner:
         self.pack_width = int(pack_width)
         self.mesh = mesh
         self.axis = axis
+        #: in-trace telemetry per lane (obs/device_metrics.py): demux
+        #: then emits one decoded device_telemetry record per member —
+        #: the megabatch tier's join onto the device metrics plane.
+        #: Resolved here because the flag changes the compiled program.
+        self.device_metrics = (
+            device_metrics_default() if device_metrics is None
+            else bool(device_metrics)
+        )
         self._lock = threading.Lock()
         self._compiled = None
         self._dim: Optional[int] = None
+        # the bin schema is a host constant burned into the trace —
+        # resolved OUTSIDE the traced closure (obs-emit-in-jit contract)
+        edges = None
+        if self.device_metrics:
+            from hpbandster_tpu.obs.device_metrics import bin_edges
+
+            edges = bin_edges().astype(np.float32)
 
         def packed_bracket(vectors, counts):
             return fused_sh_bracket_bucketed_packed(
-                eval_fn, vectors, counts, bucket
+                eval_fn, vectors, counts, bucket, telemetry_edges=edges
             )
 
         jit_kwargs: Dict = {
@@ -218,15 +237,19 @@ class MegaRunner:
     ) -> List[List[Tuple[np.ndarray, np.ndarray]]]:
         """Blocking fetch of one dispatch, cut back into each member's
         TRUE-shape per-stage ``(indices, losses)`` — the per-tenant view,
-        in ``entries`` order."""
+        in ``entries`` order. Telemetry-carrying dispatches
+        (``device_metrics=True``) additionally emit one decoded
+        ``device_telemetry`` record per member lane."""
         import jax
 
         from hpbandster_tpu.obs.runtime import note_transfer
 
-        idx_lanes, loss_lanes = jax.device_get(tuple(packed))
+        fetched = jax.device_get(tuple(packed))
         note_transfer(
-            "d2h", idx_lanes.nbytes + loss_lanes.nbytes, buffers=2
+            "d2h", sum(int(a.nbytes) for a in fetched), buffers=len(fetched)
         )
+        idx_lanes, loss_lanes = fetched[0], fetched[1]
+        telemetry = fetched[2:] if len(fetched) == 4 else None
         out: List[List[Tuple[np.ndarray, np.ndarray]]] = []
         for lane, e in enumerate(entries):
             stages, off = [], 0
@@ -237,6 +260,20 @@ class MegaRunner:
                 ))
                 off += w
             out.append(slice_member_stages(stages, e.plan, e.entry))
+            if telemetry is not None:
+                from hpbandster_tpu.obs.device_metrics import (
+                    emit_device_telemetry,
+                    publish_device_metrics,
+                )
+
+                rec = member_telemetry_record(
+                    telemetry[0][lane], telemetry[1][lane],
+                    member_counts_for(self.bucket, e.plan, e.entry),
+                    self.bucket.budgets, stages,
+                )
+                if rec is not None:
+                    publish_device_metrics(rec)
+                    emit_device_telemetry(rec)
         return out
 
     def run_packed(
@@ -247,9 +284,9 @@ class MegaRunner:
 
 
 #: process-wide packed-program cache — same policy as the solo
-#: _BUCKET_FN_CACHE: an (objective, bucket, width, mesh) combination
-#: compiles once per process, bounded so throwaway pools cannot pin
-#: executables forever
+#: _BUCKET_FN_CACHE: an (objective, bucket, width, mesh, telemetry-flag)
+#: combination compiles once per process, bounded so throwaway pools
+#: cannot pin executables forever
 _MEGA_FN_CACHE: LRUCache = LRUCache(maxsize=64)
 
 
@@ -259,13 +296,23 @@ def make_mega_runner(
     pack_width: int = 8,
     mesh=None,
     axis: str = "config",
+    device_metrics: Optional[bool] = None,
 ) -> MegaRunner:
-    """The (process-cached) packed runner for one bucket program."""
-    key = (eval_fn, bucket, int(pack_width), mesh, axis)
+    """The (process-cached) packed runner for one bucket program. The
+    telemetry flag resolves BEFORE the cache key (the
+    ``make_bucketed_bracket_fn`` contract): a mid-process
+    ``HPB_DEVICE_METRICS`` flip misses the cache, never serves the other
+    program."""
+    from hpbandster_tpu.obs.device_metrics import device_metrics_default
+
+    if device_metrics is None:
+        device_metrics = device_metrics_default()
+    key = (eval_fn, bucket, int(pack_width), mesh, axis, bool(device_metrics))
     runner = _MEGA_FN_CACHE.get(key)
     if runner is None:
         runner = MegaRunner(
-            eval_fn, bucket, pack_width=pack_width, mesh=mesh, axis=axis
+            eval_fn, bucket, pack_width=pack_width, mesh=mesh, axis=axis,
+            device_metrics=device_metrics,
         )
         _MEGA_FN_CACHE[key] = runner
     return runner

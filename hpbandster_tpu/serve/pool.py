@@ -176,6 +176,9 @@ class ServePool:
         pack_min: int = 2,
         pack_window_s: float = 0.01,
         round_capacity: Optional[float] = None,
+        continuous: bool = False,
+        lane_count: int = 8,
+        lane_mesh=None,
         logger: Optional[logging.Logger] = None,
     ):
         from hpbandster_tpu.utils.compile_cache import (
@@ -197,6 +200,16 @@ class ServePool:
         #: max cost one round may dispatch (None = everything selectable);
         #: the saturation knob fairness is measured under
         self.round_capacity = round_capacity
+        #: continuous batching (serve/continuous.py): bracket items ride
+        #: one RESIDENT lane program per bucket family (fixed lane count,
+        #: compiled once, per-lane incumbent carry device-resident across
+        #: chunks) instead of one-shot solo/megabatch dispatches
+        self.continuous = bool(continuous)
+        self.lane_count = max(int(lane_count), 1)
+        #: optional 2-D lane x config mesh (continuous.make_lane_mesh);
+        #: None = unsharded lanes
+        self.lane_mesh = lane_mesh
+        self._continuous_runners: Dict[Any, Any] = {}
         self.logger = logger or logging.getLogger("hpbandster_tpu.serve")
 
         self._cond = threading.Condition()
@@ -231,6 +244,7 @@ class ServePool:
 
     def release_tenant(self, tenant_id: str) -> None:
         tenant = str(tenant_id)
+        runners = []
         with self._cond:
             n = self._tenants.get(tenant, 0) - 1
             if n > 0:
@@ -244,7 +258,13 @@ class ServePool:
                     self._queues.pop(tenant, None)
                     self._weights.pop(tenant, None)
                     self.scheduler.forget(tenant)
+                    # continuous mode: the tenant's warm lanes return to
+                    # the free pool (lane_released events) so the next
+                    # chunk admits newly submitted sweeps into them
+                    runners = list(self._continuous_runners.values())
             self._cond.notify_all()
+        for r in runners:
+            r.release_tenant(tenant)
 
     def tenants(self) -> List[str]:
         with self._cond:
@@ -281,19 +301,31 @@ class ServePool:
             mesh_size = 1
             if mesh is not None:
                 mesh_size = int(dict(mesh.shape).get(axis, 1))
+            if self.continuous and self.lane_mesh is not None:
+                # the resident lane programs shard rows over the lane
+                # mesh's config axis: widths must be multiples of IT
+                mesh_size = max(
+                    mesh_size,
+                    int(dict(self.lane_mesh.shape).get("config", 1)),
+                )
             self._bucket_set = build_bucket_set(
                 self._bucket_plans, mesh_size=mesh_size
             )
             bucket_set = self._bucket_set
         try:
-            self._precompile = precompile_buckets(
-                self.backend.eval_fn,
-                bucket_set,
-                d=self.configspace.dim,
-                mesh=mesh,
-                axis=axis,
-                background=True,
-            )
+            if self.continuous:
+                # warm the RESIDENT programs (one per family) instead of
+                # the solo runners the continuous path never dispatches
+                self._precompile = self._precompile_continuous(bucket_set)
+            else:
+                self._precompile = precompile_buckets(
+                    self.backend.eval_fn,
+                    bucket_set,
+                    d=self.configspace.dim,
+                    mesh=mesh,
+                    axis=axis,
+                    background=True,
+                )
         except Exception:
             # precompile is an optimization; dispatch-time compile works
             self.logger.exception("bucket precompile failed; continuing")
@@ -301,6 +333,52 @@ class ServePool:
             "serve bucket set: %d shapes -> %d programs",
             len(bucket_set.assignment), len(bucket_set.buckets),
         )
+
+    def _continuous_runner(self, bucket):
+        """The (pool-cached) resident lane program for one bucket family
+        — created once per family, compiled once per process (the
+        <= len(bucket_set) ledger contract continuous batching pins)."""
+        from hpbandster_tpu.serve.continuous import ContinuousRunner
+
+        with self._cond:
+            runner = self._continuous_runners.get(bucket)
+            if runner is None:
+                runner = ContinuousRunner(
+                    self.backend.eval_fn,
+                    bucket,
+                    lane_count=self.lane_count,
+                    mesh=self.lane_mesh,
+                    family=len(self._continuous_runners),
+                )
+                self._continuous_runners[bucket] = runner
+            return runner
+
+    def _precompile_continuous(self, bucket_set):
+        """Background-AOT the resident lane programs (the continuous
+        sibling of ``precompile_buckets`` — same daemon-thread overlap
+        with stage-0 sampling, same dispatch-is-safe-earlier contract)."""
+        import threading as _threading
+
+        runners = [
+            self._continuous_runner(b) for b in bucket_set.buckets
+        ]
+        d = self.configspace.dim
+
+        def work():
+            for r in runners:
+                try:
+                    r.ensure_compiled(d)
+                except Exception:
+                    self.logger.exception(
+                        "continuous precompile failed; dispatch-time "
+                        "compile still works"
+                    )
+
+        t = _threading.Thread(
+            target=work, daemon=True, name="continuous-precompile"
+        )
+        t.start()
+        return t
 
     def _placement(self, info) -> Optional[Tuple[Any, Any, int]]:
         """(bucket_plan, member_plan, entry) for a bracket shape, or
@@ -490,6 +568,12 @@ class ServePool:
         brackets = [it for it in items if it.kind == "bracket"]
         stages = [it for it in items if it.kind == "stage"]
 
+        if self.continuous and brackets:
+            self._run_brackets_continuous(brackets)
+            for budget_group in self._stage_groups(stages):
+                self._run_stage_group(budget_group)
+            return
+
         by_bucket: Dict[Any, List[_WorkItem]] = {}
         for it in brackets:
             by_bucket.setdefault(it.bucket, []).append(it)
@@ -532,6 +616,92 @@ class ServePool:
         for budget_group in self._stage_groups(stages):
             self._run_stage_group(budget_group)
 
+    def _run_brackets_continuous(self, brackets: List[_WorkItem]) -> None:
+        """One round's bracket items through the RESIDENT lane programs.
+
+        Per bucket family: items board chunks of ``lane_count`` in
+        deficit order (the scheduler's lane-allocation role — the
+        deepest-owed tenants' items take lanes first when a chunk cannot
+        hold everyone; the rest ride the NEXT chunk of the same round, so
+        nothing starves), the family runner zero-count-masks empty lanes
+        and threads its incumbent carry device-to-device, and each item's
+        demuxed TRUE-shape stages land exactly like the one-shot paths'
+        (bit-identical — test-pinned). Failures are contained per chunk.
+        """
+        m = obs.get_metrics()
+        by_bucket: Dict[Any, List[_WorkItem]] = {}
+        for it in brackets:
+            by_bucket.setdefault(it.bucket, []).append(it)
+        rank = self.scheduler.deficit_order(
+            [it.tenant for it in brackets]
+        )
+        d = self.configspace.dim
+        #: (fetch, chunk) pairs — EVERY chunk launches before the first
+        #: fetch (same-family chunks chain through the device-resident
+        #: carry, so no fetch is needed between them), overlapping each
+        #: chunk's device work with the previous one's d2h + demux
+        pending: List[Tuple[Callable[[], Any], List[_WorkItem]]] = []
+        for bucket, group in sorted(by_bucket.items(), key=lambda kv: kv[0]):
+            runner = self._continuous_runner(bucket)
+            group = sorted(
+                group,
+                key=lambda it: (rank.get(it.tenant, len(rank)),
+                                it.enqueue_mono),
+            )
+            for i in range(0, len(group), runner.lane_count):
+                chunk = group[i:i + runner.lane_count]
+                waiting = len(group) - (i + len(chunk))
+                entries = [
+                    PackEntry(it.tenant, it.vectors, it.plan, it.entry)
+                    for it in chunk
+                ]
+                try:
+                    with obs.span(
+                        "continuous_chunk", n_brackets=len(chunk),
+                        family=runner.family,
+                        tenants=len({it.tenant for it in chunk}),
+                    ):
+                        fetch = runner.dispatch_chunk(
+                            entries, d, waiting=waiting
+                        )
+                except Exception as e:
+                    self.logger.exception("continuous chunk failed")
+                    for it in chunk:
+                        it.error = f"continuous chunk failed: {e!r}"
+                    continue
+                pending.append((fetch, chunk))
+        for fetch, chunk in pending:
+            try:
+                with obs.span(
+                    "continuous_fetch", n_brackets=len(chunk),
+                ):
+                    results = fetch()
+            except Exception as e:
+                self.logger.exception("continuous fetch failed")
+                for it in chunk:
+                    it.error = f"continuous fetch failed: {e!r}"
+                continue
+            for it, member_stages in zip(chunk, results):
+                it.result = member_stages
+        # pool-level lane census after the round (the obs top / watch
+        # lane columns): occupancy is OWNED lanes — warm state parked on
+        # the mesh — not just lanes that ran this round
+        total = occupied = starved = 0
+        with self._cond:
+            runners = list(self._continuous_runners.values())
+        for r in runners:
+            snap = r.snapshot()
+            total += snap["lane_count"]
+            occupied += snap["occupied"]
+            starved += snap["starved"]
+        if total:
+            m.gauge("serve.lanes.total").set(total)
+            m.gauge("serve.lanes.occupied").set(occupied)
+            m.gauge("serve.lane_occupancy").set(
+                round(occupied / total, 4)
+            )
+            m.gauge("serve.lanes.starved").set(starved)
+
     def _dispatch_packed(
         self, chunk: List[_WorkItem], bucket, d: int
     ) -> Tuple[Callable[[], None], List[_WorkItem]]:
@@ -573,12 +743,11 @@ class ServePool:
         the process (same ``_BUCKET_FN_CACHE`` entry)."""
         from hpbandster_tpu.ops.buckets import (
             make_bucketed_bracket_fn,
+            member_counts_for,
             slice_member_stages,
         )
 
-        counts = np.zeros(bucket.depth, np.int32)
-        for s, k in enumerate(item.plan.num_configs):
-            counts[item.entry + s] = int(k)
+        counts = member_counts_for(bucket, item.plan, item.entry)
         try:
             runner = make_bucketed_bracket_fn(
                 self.backend.eval_fn, bucket, mesh=mesh, axis=axis
@@ -663,7 +832,7 @@ class ServePool:
     def snapshot(self) -> Dict[str, Any]:
         """Pool introspection (the frontend's health in_flight section)."""
         with self._cond:
-            return {
+            out = {
                 "tenants": sorted(self._tenants),
                 "queued_items": {
                     t: len(q) for t, q in self._queues.items() if q
@@ -680,3 +849,7 @@ class ServePool:
                     )
                 },
             }
+            runners = list(self._continuous_runners.values())
+        if self.continuous:
+            out["lanes"] = [r.snapshot() for r in runners]
+        return out

@@ -290,6 +290,24 @@ class DeficitFairScheduler:
             selected.append((t, item))
         return selected
 
+    def deficit_order(self, tenants: Sequence[str]) -> Dict[str, int]:
+        """Rank ``tenants`` most-owed first — the per-round LANE
+        allocation order of the continuous-batching tier
+        (``serve/continuous.py``): when one chunk cannot board every
+        selected item, the deepest-deficit tenants' items take lanes
+        first and the rest ride the next chunk. Ties break by arrival
+        order then name (deterministic, like ``select``). Returns
+        ``{tenant: rank}`` with rank 0 the most owed."""
+        uniq = sorted(
+            set(tenants),
+            key=lambda t: (
+                -self._deficit.get(t, 0.0),
+                self._order.get(t, float("inf")),
+                t,
+            ),
+        )
+        return {t: i for i, t in enumerate(uniq)}
+
     def forget(self, tenant: str) -> None:
         """Drop a departed tenant's round state (deficit + arrival slot)
         so a long-lived serving process does not grow scheduling entries

@@ -313,6 +313,47 @@ class TestResidentTier:
             bench.BUDGET_VERDICTS.pop("resident_100k", None)
 
 
+class TestServeContinuousTier:
+    """ISSUE 15 acceptance: the ``serve_continuous`` tier runs END TO END
+    (small lane count, 8-device CPU mesh conftest), budget-gated, with
+    the compile ledger pinned <= len(bucket_set) across the churning
+    workload and the fairness bar holding under continuous allocation."""
+
+    def test_serve_continuous_tier_runs_budget_gated(self):
+        errors = {}
+        out = bench._run_tier(
+            errors, "serve_continuous", bench.bench_serve_continuous,
+            n_tenants=3, lane_count=2, repeats=3,
+        )
+        try:
+            assert errors == {}, errors
+            assert out is not None
+            # one resident program per bucket family, however many
+            # tenants came and went (the continuous-batching contract)
+            led = out["compile_ledger"]
+            assert led["pinned"] is True
+            assert (
+                led["continuous_bracket_compiles"]
+                <= led["bucket_programs"]
+            )
+            # both arms measured and comparable
+            assert out["median"] > 0 and out["one_shot"]["median"] > 0
+            lat = out["p95_admission_to_first_result_s"]
+            assert lat["continuous"] is not None
+            assert lat["one_shot"] is not None
+            # lanes: fully packed rounds, nobody starved
+            assert out["lanes_starved"] == 0
+            assert 0 < out["lane_occupancy"] <= 1.0
+            assert out["chunks"] >= 1
+            # the fairness bar (no tenant below 80% fair share)
+            assert out["fairness"]["ok"] is True, out["fairness"]
+            v = bench.BUDGET_VERDICTS["serve_continuous"]
+            assert v["ok"], v
+        finally:
+            bench.COMPILE_BY_TIER.pop("serve_continuous", None)
+            bench.BUDGET_VERDICTS.pop("serve_continuous", None)
+
+
 def _baseline_stub(tmp_path):
     p = tmp_path / "BASELINE.md"
     p.write_text("# header kept\n\n" + bench.BASELINE_MARK + " old)\nold table\n")
@@ -561,6 +602,17 @@ def _stub_tiers(monkeypatch, calls):
         and {"n_tenants": 16, "median": 100.0, "iqr": [90.0, 110.0],
              "packing_efficiency": 1.2, "p95_queue_wait_s": 0.05})
     monkeypatch.setattr(
+        bench, "bench_serve_continuous",
+        lambda **kw: calls.setdefault("serve_continuous", True)
+        and {"n_tenants": 8, "lane_count": 4, "median": 120.0,
+             "iqr": [110.0, 130.0], "continuous_vs_one_shot": 1.1,
+             "p95_admission_to_first_result_s": {"continuous": 0.03,
+                                                 "one_shot": 0.05},
+             "lane_occupancy": 1.0, "lanes_starved": 0,
+             "compile_ledger": {"continuous_bracket_compiles": 1,
+                                "bucket_programs": 1, "pinned": True},
+             "fairness": {"min_share_ratio": 1.0, "ok": True}})
+    monkeypatch.setattr(
         bench, "bench_chaos",
         lambda **kw: calls.setdefault("chaos", True)
         and {"n_workers": 4, "median": 50.0, "iqr": [45.0, 55.0],
@@ -761,9 +813,9 @@ class TestTierSelection:
             "cnn", "cnn_wide", "pallas", "resnet", "transformer",
             "fused_1M", "fused_100k", "resident_100k", "fused10k",
             "chunked10k", "chunked_compile", "fused", "rpc", "batched",
-            "teacher", "multitenant", "chaos", "async_straggler",
-            "obs_overhead", "runtime_overhead", "collector_overhead",
-            "report_100k",
+            "teacher", "multitenant", "serve_continuous", "chaos",
+            "async_straggler", "obs_overhead", "runtime_overhead",
+            "collector_overhead", "report_100k",
         }
 
 

@@ -1,0 +1,518 @@
+"""Continuous-batching tests: lane churn parity, warm-program ledger,
+lane allocation, obs lane surfaces (ISSUE 15).
+
+Acceptance bars:
+
+* **churn bit-parity** — tenants joining and leaving across chunk
+  boundaries get results bit-identical to their solo runs, whatever the
+  lane they land on or the carry state they inherit (reset masks make
+  inherited state unreadable);
+* **one program per bucket family** — across a seeded join/leave
+  schedule the ``continuous_bracket`` compile ledger stays
+  ``<= len(bucket_set)``: tenant churn never recompiles;
+* **device-resident incumbent carry** — per-lane incumbents fold
+  correctly across chunks, survive warm reuse, and NEVER leak across an
+  ownership change;
+* lane gauges/events and the ``obs top`` / ``watch --snapshot`` lane
+  columns render.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from hpbandster_tpu import obs
+from hpbandster_tpu.obs import events as E
+from hpbandster_tpu.obs.runtime import get_compile_tracker
+from hpbandster_tpu.ops.bracket import BracketPlan
+from hpbandster_tpu.ops.buckets import (
+    build_bucket_set,
+    fused_sh_bracket_bucketed_packed,
+    fused_sh_bracket_bucketed_packed_carry,
+    make_bucketed_bracket_fn,
+    member_counts_for,
+)
+from hpbandster_tpu.ops.sweep import decode_lane_state, init_lane_state
+from hpbandster_tpu.serve import (
+    ContinuousRunner,
+    DeficitFairScheduler,
+    LaneAllocator,
+    PackEntry,
+    ServePool,
+    make_lane_mesh,
+)
+from hpbandster_tpu.workloads.toys import branin_from_vector, branin_space
+
+PLAN = BracketPlan(num_configs=(9, 3, 1), budgets=(1.0, 3.0, 9.0))
+
+
+def _bucket(mesh_size=1):
+    return build_bucket_set([PLAN], mesh_size=mesh_size).buckets[0]
+
+
+def _vectors(seed, n=9, d=2):
+    rng = np.random.default_rng(seed)
+    return rng.uniform(-1.0, 1.0, size=(n, d)).astype(np.float32)
+
+
+def _ledger(fn="continuous_bracket"):
+    return (
+        get_compile_tracker().snapshot()["functions"]
+        .get(fn, {}).get("compiles", 0)
+    )
+
+
+# ----------------------------------------------------------------- kernel
+class TestCarryKernel:
+    def test_packed_outputs_bit_identical_to_uncarried(self):
+        """The carry fold is pure addition: (idx, losses) match the
+        established packed kernel bit for bit."""
+        bucket = _bucket()
+        P = 4
+        vecs = np.zeros((P, bucket.widths[0], 2), np.float32)
+        counts = np.zeros((P, bucket.depth), np.int32)
+        for lane, seed in ((0, 3), (2, 4)):
+            vecs[lane, :9] = _vectors(seed)
+            counts[lane] = member_counts_for(bucket, PLAN, 0)
+        (idx_c, loss_c), carry = fused_sh_bracket_bucketed_packed_carry(
+            branin_from_vector, vecs, counts, init_lane_state(P),
+            np.zeros(P, bool), bucket,
+        )
+        idx_p, loss_p = fused_sh_bracket_bucketed_packed(
+            branin_from_vector, vecs, counts, bucket
+        )
+        np.testing.assert_array_equal(np.asarray(idx_c), np.asarray(idx_p))
+        np.testing.assert_array_equal(
+            np.asarray(loss_c), np.asarray(loss_p)
+        )
+
+    def test_carry_folds_masked_and_resets(self):
+        bucket = _bucket()
+        P = 3
+        vecs = np.zeros((P, bucket.widths[0], 2), np.float32)
+        counts = np.zeros((P, bucket.depth), np.int32)
+        vecs[0, :9] = _vectors(7)
+        counts[0] = member_counts_for(bucket, PLAN, 0)
+        (_, loss), carry = fused_sh_bracket_bucketed_packed_carry(
+            branin_from_vector, vecs, counts, init_lane_state(P),
+            np.zeros(P, bool), bucket,
+        )
+        dec = decode_lane_state(carry)
+        final = np.asarray(loss)[0][-bucket.widths[-1]:][:1]
+        assert dec[0] == pytest.approx(float(final[0]))
+        # masked lanes fold +inf: untouched
+        assert dec[1] is None and dec[2] is None
+        # a second all-masked chunk with reset clears lane 0's incumbent
+        (_, _), carry2 = fused_sh_bracket_bucketed_packed_carry(
+            branin_from_vector, np.zeros_like(vecs),
+            np.zeros_like(counts), carry,
+            np.array([True, False, False]), bucket,
+        )
+        assert decode_lane_state(carry2) == [None, None, None]
+
+    def test_crashed_only_lane_decodes_nan(self):
+        def crashy(v, budget):
+            import jax.numpy as jnp
+
+            return jnp.full((), jnp.nan, jnp.float32)
+
+        bucket = _bucket()
+        vecs = np.zeros((1, bucket.widths[0], 2), np.float32)
+        vecs[0, :9] = _vectors(5)
+        counts = member_counts_for(bucket, PLAN, 0)[None, :]
+        (_, _), carry = fused_sh_bracket_bucketed_packed_carry(
+            crashy, vecs, counts, init_lane_state(1),
+            np.zeros(1, bool), bucket,
+        )
+        dec = decode_lane_state(carry)
+        assert len(dec) == 1 and np.isnan(dec[0])
+
+
+# -------------------------------------------------------------- allocator
+class TestLaneAllocator:
+    def test_sticky_tenant_keeps_warm_lane(self):
+        a = LaneAllocator(3)
+        assert a.assign(["t1", "t2"]) == [(0, False), (1, False)]
+        # t1 returns: same lane, warm
+        assert a.assign(["t1"]) == [(0, True)]
+        assert a.owners == ["t1", "t2", None]
+
+    def test_steal_lru_marks_dirty(self):
+        a = LaneAllocator(2)
+        a.assign(["t1", "t2"])
+        a.dirty.clear()
+        a.assign(["t1"])  # t1 fresher than t2
+        # t3 must steal t2's lane (LRU) and dirty it
+        placements = a.assign(["t3"])
+        assert placements == [(1, False)]
+        assert a.owners == ["t1", "t3"]
+        assert 1 in a.dirty
+
+    def test_steal_never_evicts_a_boarding_tenants_warm_lane(self):
+        """Review regression: a newcomer's LRU steal must pick an ABSENT
+        tenant's lane, never a lane whose owner boards this very chunk —
+        B keeps its warm lane (and its on-device incumbent) even when it
+        is the LRU one."""
+        a = LaneAllocator(2)
+        a.assign(["B", "C"])   # B -> lane0, C -> lane1
+        a.assign(["C"])        # lane0 (B's) is now the LRU lane
+        a.dirty.clear()
+        placements = dict(zip(["A", "B"], a.assign(["A", "B"])))
+        assert placements["B"] == (0, True)   # warm, NOT stolen
+        assert placements["A"] == (1, False)  # absent C's lane
+        assert a.dirty == {1}
+
+    def test_release_frees_and_dirties(self):
+        a = LaneAllocator(2)
+        a.assign(["t1", "t2"])
+        a.dirty.clear()
+        assert a.release_tenant("t1") == [0]
+        assert a.owners == [None, "t2"]
+        assert a.dirty == {0}
+
+    def test_overflow_raises(self):
+        a = LaneAllocator(1)
+        with pytest.raises(ValueError):
+            a.assign(["a", "b"])
+
+    def test_deficit_order_ranks_most_owed_first(self):
+        s = DeficitFairScheduler()
+        s._deficit.update({"a": 1.0, "b": 5.0, "c": 5.0})
+        s._order.update({"a": 0, "b": 2, "c": 1})
+        rank = s.deficit_order(["a", "b", "c"])
+        # deepest deficit first; ties break by arrival order
+        assert rank == {"c": 0, "b": 1, "a": 2}
+
+
+# ----------------------------------------------------------------- runner
+class TestContinuousRunner:
+    def test_seeded_churn_bit_parity_and_pinned_ledger(self):
+        """The acceptance bar: a seeded join/leave schedule across chunk
+        boundaries — every member's results bit-match its solo run, and
+        the family compiled exactly once however tenants churned."""
+        bucket = _bucket()
+        solo = make_bucketed_bracket_fn(
+            branin_from_vector, bucket, device_metrics=False
+        )
+        led0 = _ledger()
+        runner = ContinuousRunner(
+            branin_from_vector, bucket, lane_count=3
+        )
+        rng = np.random.default_rng(42)
+        tenants = [f"t{i}" for i in range(6)]
+        for step in range(8):
+            # join: a seeded subset of tenants boards this chunk
+            boarding = [
+                t for t in tenants if rng.random() < 0.5
+            ][: runner.lane_count]
+            entries = []
+            refs = []
+            for t in boarding:
+                seed = int(rng.integers(0, 1 << 30))
+                v = _vectors(seed)
+                entries.append(PackEntry(t, v, PLAN, 0))
+                refs.append(solo.run_member(v, PLAN, 0))
+            if entries:
+                out = runner.run_chunk(entries, d=2)
+                for ref, got in zip(refs, out):
+                    for (ri, rl), (gi, gl) in zip(ref, got):
+                        np.testing.assert_array_equal(ri, gi)
+                        np.testing.assert_array_equal(rl, gl)
+            # leave: a seeded tenant departs, freeing its lane
+            if rng.random() < 0.5:
+                runner.release_tenant(
+                    tenants[int(rng.integers(len(tenants)))]
+                )
+        assert _ledger() - led0 == 1  # one family, one program, forever
+        assert runner.chunks_run >= 1
+
+    def test_carry_warm_across_chunks_never_leaks_across_owners(self):
+        bucket = _bucket()
+        runner = ContinuousRunner(
+            branin_from_vector, bucket, lane_count=2
+        )
+        va, vb = _vectors(1), _vectors(2)
+        solo = make_bucketed_bracket_fn(
+            branin_from_vector, bucket, device_metrics=False
+        )
+        best = {
+            "a": float(np.nanmin(np.asarray(
+                solo.run_member(va, PLAN, 0)[-1][1]))),
+            "b": float(np.nanmin(np.asarray(
+                solo.run_member(vb, PLAN, 0)[-1][1]))),
+        }
+        runner.run_chunk(
+            [PackEntry("a", va, PLAN, 0), PackEntry("b", vb, PLAN, 0)],
+            d=2,
+        )
+        inc = runner.lane_incumbents()
+        assert inc[0] == pytest.approx(best["a"])
+        assert inc[1] == pytest.approx(best["b"])
+        # warm reuse: tenant a's second (worse-seed) bracket keeps the min
+        runner.run_chunk([PackEntry("a", vb, PLAN, 0)], d=2)
+        inc2 = runner.lane_incumbents()
+        assert inc2[0] == pytest.approx(min(best["a"], best["b"]))
+        # b departs; newcomer c lands on b's lane and must NOT inherit
+        # b's incumbent — the reset mask kills it in-trace
+        runner.release_tenant("b")
+        runner.run_chunk([PackEntry("c", va, PLAN, 0)], d=2)
+        inc3 = runner.lane_incumbents()
+        assert runner.lanes.owners[1] == "c"
+        assert inc3[1] == pytest.approx(best["a"])  # c's own result only
+
+    def test_device_metrics_flag_emits_member_records(self):
+        """Continuous serving feeds the device metrics plane like the
+        one-shot paths: with the flag on, each occupied lane's decoded
+        record emits on fetch (stage results still bit-identical)."""
+        bucket = _bucket()
+        ref = make_bucketed_bracket_fn(
+            branin_from_vector, bucket, device_metrics=False
+        ).run_member(_vectors(6), PLAN, 0)
+        runner = ContinuousRunner(
+            branin_from_vector, bucket, lane_count=2, device_metrics=True
+        )
+        recs = []
+        detach = E.get_bus().subscribe(
+            lambda ev: recs.append(ev.fields)
+            if ev.name == "device_telemetry" else None
+        )
+        try:
+            out = runner.run_chunk(
+                [PackEntry("a", _vectors(6), PLAN, 0)], d=2
+            )
+        finally:
+            detach()
+        for (ri, rl), (gi, gl) in zip(ref, out[0]):
+            np.testing.assert_array_equal(ri, gi)
+            np.testing.assert_array_equal(rl, gl)
+        # one record for the occupied lane, none for the masked one
+        assert len(recs) == 1
+        assert recs[0]["evaluations"] == sum(PLAN.num_configs)
+        assert [r["evals"] for r in recs[0]["rungs"]] == [9, 3, 1]
+
+    def test_dispatch_then_fetch_overlap_api(self):
+        """dispatch_chunk launches without blocking: a second chunk can
+        launch before the first fetch (the carry chains on-device), and
+        the deferred fetches return the same results run_chunk would."""
+        bucket = _bucket()
+        runner = ContinuousRunner(
+            branin_from_vector, bucket, lane_count=2
+        )
+        solo = make_bucketed_bracket_fn(
+            branin_from_vector, bucket, device_metrics=False
+        )
+        va, vb = _vectors(21), _vectors(22)
+        f1 = runner.dispatch_chunk([PackEntry("a", va, PLAN, 0)], d=2)
+        f2 = runner.dispatch_chunk([PackEntry("a", vb, PLAN, 0)], d=2)
+        out1, out2 = f1(), f2()
+        for ref, got in (
+            (solo.run_member(va, PLAN, 0), out1[0]),
+            (solo.run_member(vb, PLAN, 0), out2[0]),
+        ):
+            for (ri, rl), (gi, gl) in zip(ref, got):
+                np.testing.assert_array_equal(ri, gi)
+                np.testing.assert_array_equal(rl, gl)
+        # the carry saw BOTH chunks (dispatch order, not fetch order)
+        best = min(
+            float(np.nanmin(np.asarray(solo.run_member(v, PLAN, 0)[-1][1])))
+            for v in (va, vb)
+        )
+        assert runner.lane_incumbents()[0] == pytest.approx(best)
+
+    def test_lane_events_emitted(self):
+        bucket = _bucket()
+        seen = []
+
+        def sink(ev):
+            if ev.name in ("lane_assigned", "lane_released"):
+                seen.append((ev.name, ev.fields.get("tenant"),
+                             ev.fields.get("lane")))
+
+        detach = E.get_bus().subscribe(sink)
+        try:
+            runner = ContinuousRunner(
+                branin_from_vector, bucket, lane_count=2
+            )
+            runner.run_chunk([PackEntry("a", _vectors(1), PLAN, 0)], d=2)
+            runner.release_tenant("a")
+        finally:
+            detach()
+        assert ("lane_assigned", "a", 0) in seen
+        assert ("lane_released", "a", 0) in seen
+
+    def test_lane_mesh_2d_parity(self):
+        """The 2-D lane x config mesh path on the conftest 8-device CPU
+        mesh: sharded chunk results bit-match the unsharded solo run."""
+        import jax
+
+        if len(jax.devices()) != 8:
+            pytest.skip("needs the conftest-forced 8-device CPU mesh")
+        mesh = make_lane_mesh(2)
+        assert dict(mesh.shape) == {"lane": 2, "config": 4}
+        bucket = _bucket(mesh_size=4)
+        solo = make_bucketed_bracket_fn(
+            branin_from_vector, bucket, device_metrics=False
+        )
+        runner = ContinuousRunner(
+            branin_from_vector, bucket, lane_count=4, mesh=mesh
+        )
+        v = _vectors(11)
+        ref = solo.run_member(v, PLAN, 0)
+        out = runner.run_chunk([PackEntry("a", v, PLAN, 0)], d=2)
+        for (ri, rl), (gi, gl) in zip(ref, out[0]):
+            np.testing.assert_array_equal(ri, gi)
+            np.testing.assert_array_equal(rl, gl)
+        # the carry threads on-mesh too
+        best = float(np.nanmin(np.asarray(ref[-1][1])))
+        assert runner.lane_incumbents()[0] == pytest.approx(best)
+
+    def test_mesh_geometry_validation(self):
+        import jax
+
+        if len(jax.devices()) != 8:
+            pytest.skip("needs the conftest-forced 8-device CPU mesh")
+        mesh = make_lane_mesh(2)
+        with pytest.raises(ValueError, match="multiple"):
+            ContinuousRunner(
+                branin_from_vector, _bucket(mesh_size=4),
+                lane_count=3, mesh=mesh,
+            )
+        with pytest.raises(ValueError):
+            make_lane_mesh(3)
+
+
+# ----------------------------------------------------------- pool (e2e)
+def _run_tenant(pool, tenant, seed, n_iterations=1, results=None):
+    from hpbandster_tpu.optimizers import BOHB
+
+    opt = BOHB(
+        configspace=branin_space(seed=seed),
+        run_id=f"cont-{tenant}-{seed}", tenant_id=tenant,
+        executor=pool.executor_for(tenant),
+        min_budget=1, max_budget=9, eta=3, seed=seed,
+    )
+    res = opt.run(n_iterations=n_iterations)
+    opt.shutdown()
+    if results is not None:
+        results[tenant] = res
+    return res
+
+
+def _losses_by_config(result):
+    return {
+        (tuple(r.config_id), r.budget): r.loss
+        for r in result.get_all_runs()
+    }
+
+
+def _backend():
+    from hpbandster_tpu.parallel import VmapBackend
+
+    return VmapBackend(branin_from_vector)
+
+
+class TestContinuousPool:
+    def test_churning_tenants_identical_to_solo_ledger_pinned(self):
+        """Three tenants join/leave a continuous pool concurrently (lane
+        count 2 — forced multi-chunk rounds + lane churn); every tenant's
+        Result is bit-identical to its solo run through a one-shot pool,
+        and the continuous ledger stays <= len(bucket_set)."""
+        led0 = _ledger()
+        pool = ServePool(
+            _backend(), branin_space(seed=0),
+            continuous=True, lane_count=2, pack_window_s=0.02,
+        )
+        results = {}
+        threads = [
+            threading.Thread(
+                target=_run_tenant, args=(pool, f"t{i}", 20 + i, 2, results),
+                daemon=True,
+            )
+            for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert sorted(results) == ["t0", "t1", "t2"]
+        buckets = pool.snapshot()["buckets"]
+        assert buckets >= 1
+        assert _ledger() - led0 <= buckets
+        for i in range(3):
+            ref = _run_tenant(
+                ServePool(_backend(), branin_space(seed=0)),
+                f"solo{i}", 20 + i, 2,
+            )
+            assert (
+                _losses_by_config(results[f"t{i}"])
+                == _losses_by_config(ref)
+            )
+        # every tenant departed: all lanes released back to the pool
+        for lane_snap in pool.snapshot()["lanes"]:
+            assert lane_snap["occupied"] == 0
+            assert lane_snap["chunks"] >= 1
+
+    def test_lane_gauges_and_snapshot(self):
+        pool = ServePool(
+            _backend(), branin_space(seed=0),
+            continuous=True, lane_count=2, pack_window_s=0.0,
+        )
+        _run_tenant(pool, "g1", 31)
+        g = obs.get_metrics().snapshot()["gauges"]
+        assert g.get("serve.lanes.total") == 2.0
+        assert g.get("serve.lanes.starved") == 0.0
+        assert "serve.lane_occupancy" in g
+        assert g.get("serve.family.0.warm_age_s") is not None
+        snap = pool.snapshot()
+        assert snap["lanes"][0]["lane_count"] == 2
+        assert snap["lanes"][0]["warm_age_s"] is not None
+
+
+# ---------------------------------------------------------- obs surfaces
+class TestLaneObsSurfaces:
+    GAUGES = {
+        "serve.lanes.total": 4.0,
+        "serve.lanes.occupied": 3.0,
+        "serve.lanes.starved": 0.0,
+        "serve.lane_occupancy": 0.75,
+        "serve.family.0.warm_age_s": 12.5,
+        "serve.family.1.warm_age_s": 7.0,
+    }
+
+    def test_collector_lane_gauges_parser(self):
+        from hpbandster_tpu.obs.collector import lane_gauges
+
+        lanes = lane_gauges(self.GAUGES)
+        assert lanes == {
+            "total": 4.0, "occupied": 3.0, "starved": 0.0,
+            "occupancy": 0.75, "warm_age_s": 12.5, "families": 2,
+        }
+        assert lane_gauges({"unrelated": 1.0}) == {}
+
+    def test_endpoint_row_and_fleet_table_lane_line(self):
+        from hpbandster_tpu.obs.collector import (
+            _endpoint_row,
+            format_fleet_table,
+        )
+
+        row = _endpoint_row(
+            {"component": "serve", "metrics": {"gauges": self.GAUGES}}
+        )
+        assert row["lanes"]["occupied"] == 3.0
+        table = format_fleet_table(
+            {"fleet": {}, "endpoints": {"serve": row}}
+        )
+        assert "lanes: occupied=3/4  starved=0  warm_age_s=12.5" in table
+        # lane-free fleets render without the line
+        bare = _endpoint_row({"component": "w", "metrics": {"gauges": {}}})
+        assert "lanes:" not in format_fleet_table(
+            {"fleet": {}, "endpoints": {"w": bare}}
+        )
+
+    def test_watch_snapshot_lane_part(self):
+        from hpbandster_tpu.obs.summarize import _snapshot_lane_part
+
+        part = _snapshot_lane_part({"metrics": {"gauges": self.GAUGES}})
+        assert part == " lanes: occ=3/4 starved=0 warm_age=12.5s"
+        assert _snapshot_lane_part({"metrics": {"gauges": {}}}) == ""

@@ -670,3 +670,150 @@ class TestParetoCostFeed:
         for _ in range(3):  # below the trust threshold
             reg.histogram("master.job_run_s.b1").observe(0.25)
         assert budget_cost_from_obs(1.0, registry=reg) is None
+
+
+# -------------------------------------------------- bucketed runner seam
+class TestBucketRunnerTelemetry:
+    """ISSUE 15 satellite (carried PR 13 remainder): the bucketed and
+    megabatch runners EMIT the device_telemetry record when the flag is
+    on — the kernel seam (``bucketed_stage_telemetry``) finally has a
+    caller — with promotion/eval counts matching the member plan, stage
+    results bit-identical to the telemetry-free program, and the flag in
+    the process caches (no silent cross-serving of programs)."""
+
+    PLAN = BracketPlan(num_configs=(9, 3, 1), budgets=(1.0, 3.0, 9.0))
+
+    def _fixtures(self):
+        from hpbandster_tpu.ops.buckets import build_bucket_set
+        from hpbandster_tpu.workloads.toys import branin_from_vector
+
+        bucket = build_bucket_set([self.PLAN]).buckets[0]
+        rng = np.random.default_rng(9)
+        vectors = rng.uniform(-1, 1, size=(9, 2)).astype(np.float32)
+        return bucket, vectors, branin_from_vector
+
+    def _collect(self, fn):
+        from hpbandster_tpu.obs import events as E
+
+        recs = []
+        detach = E.get_bus().subscribe(
+            lambda ev: recs.append(ev.fields)
+            if ev.name == "device_telemetry" else None
+        )
+        try:
+            out = fn()
+        finally:
+            detach()
+        return out, recs
+
+    def test_bucket_runner_emits_record_with_parity(self):
+        from hpbandster_tpu.ops.buckets import make_bucketed_bracket_fn
+
+        bucket, vectors, eval_fn = self._fixtures()
+        ref = make_bucketed_bracket_fn(
+            eval_fn, bucket, device_metrics=False
+        ).run_member(vectors, self.PLAN, 0)
+        runner = make_bucketed_bracket_fn(
+            eval_fn, bucket, device_metrics=True
+        )
+        assert runner.device_metrics is True
+        stages, recs = self._collect(
+            lambda: runner.run_member(vectors, self.PLAN, 0)
+        )
+        for (ri, rl), (gi, gl) in zip(ref, stages):
+            np.testing.assert_array_equal(ri, gi)
+            np.testing.assert_array_equal(rl, gl)
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["brackets"] == 1
+        assert rec["evaluations"] == sum(self.PLAN.num_configs)
+        assert rec["promotions"] == sum(self.PLAN.num_configs[1:])
+        assert [r["budget"] for r in rec["rungs"]] == [1.0, 3.0, 9.0]
+        assert [r["evals"] for r in rec["rungs"]] == [9, 3, 1]
+        # the histogram covers exactly the member's true rows
+        assert sum(rec["rungs"][0]["hist"]) == 9
+        best = float(np.nanmin(np.asarray(ref[-1][1])))
+        assert rec["per_bracket_best"][0] == pytest.approx(best, abs=1e-6)
+
+    def test_crashes_counted_not_histogrammed(self):
+        from hpbandster_tpu.ops.buckets import make_bucketed_bracket_fn
+        from hpbandster_tpu.workloads.toys import branin_from_vector
+
+        def crashy(v, budget):
+            import jax.numpy as jnp
+
+            return jnp.where(
+                v[0] > 0.0, jnp.nan, branin_from_vector(v, budget)
+            )
+
+        bucket, vectors, _ = self._fixtures()
+        runner = make_bucketed_bracket_fn(
+            crashy, bucket, device_metrics=True
+        )
+        stages, recs = self._collect(
+            lambda: runner.run_member(vectors, self.PLAN, 0)
+        )
+        rec = recs[0]
+        n_crash_s0 = int(np.isnan(np.asarray(stages[0][1])).sum())
+        assert rec["rungs"][0]["crashes"] == n_crash_s0
+        assert sum(rec["rungs"][0]["hist"]) == 9 - n_crash_s0
+
+    def test_mega_runner_emits_one_record_per_member(self):
+        from hpbandster_tpu.serve.megabatch import (
+            PackEntry,
+            make_mega_runner,
+        )
+
+        bucket, vectors, eval_fn = self._fixtures()
+        rng = np.random.default_rng(10)
+        v2 = rng.uniform(-1, 1, size=(9, 2)).astype(np.float32)
+        runner = make_mega_runner(
+            eval_fn, bucket, pack_width=4, device_metrics=True
+        )
+        entries = [
+            PackEntry("a", vectors, self.PLAN, 0),
+            PackEntry("b", v2, self.PLAN, 0),
+        ]
+        out, recs = self._collect(lambda: runner.run_packed(entries, d=2))
+        # one record per member lane, none for the padding lanes
+        assert len(recs) == 2
+        for rec in recs:
+            assert rec["evaluations"] == sum(self.PLAN.num_configs)
+        from hpbandster_tpu.ops.buckets import make_bucketed_bracket_fn
+
+        ref = make_bucketed_bracket_fn(
+            eval_fn, bucket, device_metrics=False
+        ).run_member(vectors, self.PLAN, 0)
+        for (ri, rl), (gi, gl) in zip(ref, out[0]):
+            np.testing.assert_array_equal(ri, gi)
+            np.testing.assert_array_equal(rl, gl)
+
+    def test_flag_splits_the_process_caches(self):
+        from hpbandster_tpu.ops.buckets import make_bucketed_bracket_fn
+        from hpbandster_tpu.serve.megabatch import make_mega_runner
+
+        bucket, _, eval_fn = self._fixtures()
+        on = make_bucketed_bracket_fn(eval_fn, bucket, device_metrics=True)
+        off = make_bucketed_bracket_fn(
+            eval_fn, bucket, device_metrics=False
+        )
+        assert on is not off
+        assert on is make_bucketed_bracket_fn(
+            eval_fn, bucket, device_metrics=True
+        )
+        m_on = make_mega_runner(eval_fn, bucket, device_metrics=True)
+        m_off = make_mega_runner(eval_fn, bucket, device_metrics=False)
+        assert m_on is not m_off
+
+    def test_gauges_published_on_unpack(self):
+        from hpbandster_tpu.ops.buckets import make_bucketed_bracket_fn
+
+        bucket, vectors, eval_fn = self._fixtures()
+        runner = make_bucketed_bracket_fn(
+            eval_fn, bucket, device_metrics=True
+        )
+        runner.run_member(vectors, self.PLAN, 0)
+        g = obs.get_metrics().snapshot()["gauges"]
+        assert g.get("sweep.device_metrics.evaluations") == float(
+            sum(self.PLAN.num_configs)
+        )

@@ -1102,3 +1102,91 @@ class TestServingObservability:
         rep = json.loads(capsys.readouterr().out)
         traj = rep["incumbent_trajectory"]
         assert len(traj) == 1 and traj[0]["loss"] == 0.5
+
+
+# ------------------------------------------------------------------ authn
+class TestTenantAuthn:
+    """ISSUE 15 satellite: optional per-tenant shared-secret tokens on
+    submit_sweep / sweep_status / sweep_result — reject-with-reason,
+    constant-time compare, and the secret NEVER lands in a journal."""
+
+    def _frontend(self, tokens, store=None):
+        pool = ServePool(
+            _smoke_backend(), branin_space(seed=0), pack_window_s=0.0
+        )
+        # never start()ed: these are in-process API tests (the socket
+        # round-trip is the slow-marked e2e's job); sweep threads are
+        # daemons and drain on their own
+        return ServeFrontend(pool, auth_tokens=tokens, store=store)
+
+    @staticmethod
+    def _wait_done(fe, tenant, sid, token=None, timeout=60):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = fe.sweep_status(tenant, sid, token=token)
+            if st.get("state") in ("done", "failed"):
+                return st
+            time.sleep(0.05)
+        raise AssertionError(f"sweep {sid} never finished")
+
+    def test_open_mode_unchanged_without_tokens(self):
+        fe = self._frontend(None)
+        out = fe.submit_sweep("acme", {"n_iterations": 1})
+        assert out["accepted"] is True
+        assert self._wait_done(fe, "acme", out["sweep_id"])["state"] == "done"
+
+    def test_submit_rejects_wrong_and_missing_token(self):
+        fe = self._frontend({"acme": "s3cret"})
+        out = fe.submit_sweep("acme", {"n_iterations": 1})
+        assert out["accepted"] is False
+        assert "authentication failed" in out["reason"]
+        out = fe.submit_sweep("acme", {"n_iterations": 1}, token="wrong")
+        assert out["accepted"] is False
+        # an unknown tenant reads identically to a wrong token, and the
+        # secret itself never rides a reject reason
+        out = fe.submit_sweep(
+            "mallory", {"n_iterations": 1}, token="s3cret"
+        )
+        assert out["accepted"] is False
+        assert "authentication failed" in out["reason"]
+        assert "s3cret" not in out["reason"]
+
+    def test_status_and_result_guarded_and_token_never_journaled(
+        self, tmp_path
+    ):
+        journal = str(tmp_path / "authn.jsonl")
+        handle = obs.configure(journal_path=journal)
+        try:
+            fe = self._frontend({"acme": "s3cret-tok"})
+            out = fe.submit_sweep(
+                "acme", {"n_iterations": 1}, token="s3cret-tok"
+            )
+            assert out["accepted"] is True
+            sid = out["sweep_id"]
+            # wrong/missing tokens cannot read status or results
+            assert "authentication failed" in fe.sweep_status(
+                "acme", sid
+            )["error"]
+            assert "authentication failed" in fe.sweep_result(
+                "acme", sid, token="nope"
+            )["error"]
+            st = self._wait_done(fe, "acme", sid, token="s3cret-tok")
+            assert st["state"] == "done"
+            res = fe.sweep_result("acme", sid, token="s3cret-tok")
+            assert res["incumbent"] is not None
+        finally:
+            handle.close()
+        text = open(journal).read()
+        assert "config_sampled" in text  # the sweep DID journal
+        assert "s3cret-tok" not in text  # the secret never did
+
+    def test_token_rotation_via_set_token(self):
+        fe = self._frontend(None)
+        fe.set_token("acme", "v2")
+        out = fe.submit_sweep("acme", {"n_iterations": 1})
+        assert out["accepted"] is False
+        out = fe.submit_sweep("acme", {"n_iterations": 1}, token="v2")
+        assert out["accepted"] is True
+        assert self._wait_done(
+            fe, "acme", out["sweep_id"], token="v2"
+        )["state"] == "done"
