@@ -14,6 +14,7 @@ syntax, and how to add a rule.
 from hpbandster_tpu.analysis.core import (
     DEFAULT_EXCLUDE_DIRS,
     Finding,
+    ProjectRule,
     Rule,
     SourceModule,
     all_rules,
@@ -26,6 +27,7 @@ from hpbandster_tpu.analysis.core import (
 __all__ = [
     "DEFAULT_EXCLUDE_DIRS",
     "Finding",
+    "ProjectRule",
     "Rule",
     "SourceModule",
     "all_rules",

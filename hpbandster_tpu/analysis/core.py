@@ -44,6 +44,7 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tupl
 __all__ = [
     "Finding",
     "Rule",
+    "ProjectRule",
     "SourceModule",
     "register",
     "all_rules",
@@ -65,19 +66,40 @@ _DIRECTIVE_RE = re.compile(r"graftlint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Z
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
-    """One diagnostic: ``path:line: [rule] message``."""
+    """One diagnostic: ``path:line: [rule] message``.
+
+    Interprocedural findings carry a second location: the *primary*
+    location is where the bug is entered (the call site a reviewer must
+    judge), ``related_*`` is the sink it reaches (where the damage
+    happens). A suppression directive at EITHER location mutes the
+    finding — the call site owns "this caller is safe", the sink owns
+    "this operation is safe from anywhere".
+    """
 
     rule: str
     path: str
     line: int
     message: str
+    related_path: Optional[str] = None
+    related_line: int = 0
+    related_note: str = ""
 
     @property
     def location(self) -> str:
         return f"{self.path}:{self.line}"
 
+    @property
+    def related_location(self) -> Optional[str]:
+        if self.related_path is None:
+            return None
+        return f"{self.related_path}:{self.related_line}"
+
     def __str__(self) -> str:
-        return f"{self.location}: [{self.rule}] {self.message}"
+        text = f"{self.location}: [{self.rule}] {self.message}"
+        if self.related_path is not None:
+            note = f" ({self.related_note})" if self.related_note else ""
+            text += f"\n    -> {self.related_location}{note}"
+        return text
 
 
 class SourceModule:
@@ -87,8 +109,9 @@ class SourceModule:
         self.path = path
         self.text = text
         self.tree = ast.parse(text, filename=path)
-        #: line -> set of rule names muted on that line ("all" mutes any)
-        self.suppressions: Dict[int, Set[str]] = _parse_suppressions(text)
+        #: built lazily — graph-context modules that never host a finding
+        #: skip the tokenize pass entirely (it is ~10% of a cold scan)
+        self._suppressions: Optional[Dict[int, Set[str]]] = None
         #: scratch memo shared by rules (e.g. the resolved import map) so
         #: per-module derived structures are built once, not once per rule
         self.cache: Dict[str, object] = {}
@@ -141,6 +164,13 @@ class SourceModule:
         if start is None:
             return ast.walk(node)
         return iter(order[start : end[start]])
+
+    @property
+    def suppressions(self) -> Dict[int, Set[str]]:
+        """line -> set of rule names muted on that line ("all" mutes any)."""
+        if self._suppressions is None:
+            self._suppressions = _parse_suppressions(self.text)
+        return self._suppressions
 
     def is_suppressed(self, rule: str, line: int) -> bool:
         muted = self.suppressions.get(line, ())
@@ -203,6 +233,9 @@ class Rule:
 
     name: str = ""
     description: str = ""
+    #: "module" rules see one file at a time; "project" rules (subclass
+    #: :class:`ProjectRule`) see the whole-program call graph
+    scope: str = "module"
 
     def check(self, module: SourceModule) -> List[Finding]:
         raise NotImplementedError
@@ -210,6 +243,21 @@ class Rule:
     def finding(self, module: SourceModule, node: "ast.AST | int", message: str) -> Finding:
         line = node if isinstance(node, int) else getattr(node, "lineno", 1)
         return Finding(rule=self.name, path=module.path, line=line, message=message)
+
+
+class ProjectRule(Rule):
+    """A rule that inspects the whole program at once, through the call
+    graph (``analysis/graph.py``). Subclasses implement
+    :meth:`check_project`; ``check`` is a no-op so the per-module loop
+    skips them."""
+
+    scope = "project"
+
+    def check(self, module: SourceModule) -> List[Finding]:
+        return []
+
+    def check_project(self, project) -> List[Finding]:
+        raise NotImplementedError
 
 
 _REGISTRY: Dict[str, Type[Rule]] = {}
@@ -254,12 +302,23 @@ def run(
     paths: Sequence[str],
     rules: Optional[Sequence[str]] = None,
     exclude_dirs: Iterable[str] = DEFAULT_EXCLUDE_DIRS,
+    graph_roots: Optional[Sequence[str]] = None,
 ) -> List[Finding]:
     """Run ``rules`` (default: all registered) over ``paths``; returns
     suppression-filtered findings sorted by location.
 
+    Module-scope rules see exactly the files under ``paths``. Project-scope
+    rules see the whole-program call graph built over ``graph_roots``
+    (default: the same ``paths``) *plus* ``paths``, but only report
+    findings whose primary location is inside ``paths`` — that split is
+    what makes ``--changed`` mode sound: a pre-commit hook scans two files
+    against the full graph and still sees every interprocedural finding
+    entered from them.
+
     Unreadable/unparseable files surface as ``parse-error`` findings rather
     than crashing the pass: a syntax error must fail the gate, not hide."""
+    from hpbandster_tpu.analysis import graph as graph_mod
+
     registry = all_rules()
     if rules is None:
         selected = [cls() for cls in registry.values()]
@@ -268,30 +327,64 @@ def run(
         if unknown:
             raise KeyError(f"unknown rule(s): {', '.join(unknown)}")
         selected = [registry[r]() for r in rules]
+    module_rules = [r for r in selected if r.scope == "module"]
+    project_rules = [r for r in selected if r.scope == "project"]
 
     findings: List[Finding] = []
+    # absolute paths throughout: the process-wide module cache and the
+    # project tables key on them, so a relative and an absolute spelling
+    # of one file must collapse to one parse
+    paths = [os.path.abspath(p) for p in paths]
     # a typo'd path must trip the gate, not scan zero files and pass
     for path in paths:
         if not os.path.exists(path):
             findings.append(
                 Finding("parse-error", path, 1, "path does not exist — nothing was scanned")
             )
-    for path in collect_files(paths, exclude_dirs):
+    report_files = list(collect_files(paths, exclude_dirs))
+    for path in report_files:
         try:
-            with open(path, "r", encoding="utf-8") as fh:
-                text = fh.read()
-            module = SourceModule(path, text)
+            module = graph_mod.load_module(path)
         except (OSError, SyntaxError, ValueError) as e:
             findings.append(
                 Finding("parse-error", path, getattr(e, "lineno", None) or 1, repr(e))
             )
             continue
-        for rule in selected:
+        for rule in module_rules:
             for f in rule.check(module):
                 if not module.is_suppressed(f.rule, f.line):
                     findings.append(f)
+
+    if project_rules:
+        graph_files = list(report_files)
+        if graph_roots is not None:
+            graph_files.extend(
+                collect_files([os.path.abspath(p) for p in graph_roots], exclude_dirs)
+            )
+        project = graph_mod.get_project(graph_files)
+        report_set = {os.path.abspath(p) for p in report_files}
+        for rule in project_rules:
+            for f in rule.check_project(project):
+                if os.path.abspath(f.path) not in report_set:
+                    continue
+                if _project_finding_suppressed(project, f):
+                    continue
+                findings.append(f)
+
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return findings
+
+
+def _project_finding_suppressed(project, f: Finding) -> bool:
+    """A two-location finding is muted by a directive at either end."""
+    module = project.modules.get(os.path.abspath(f.path))
+    if module is not None and module.is_suppressed(f.rule, f.line):
+        return True
+    if f.related_path is not None:
+        related = project.modules.get(os.path.abspath(f.related_path))
+        if related is not None and related.is_suppressed(f.rule, f.related_line):
+            return True
+    return False
 
 
 def format_report(findings: Sequence[Finding]) -> str:

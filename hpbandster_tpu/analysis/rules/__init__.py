@@ -10,11 +10,13 @@ from hpbandster_tpu.analysis.rules import (  # noqa: F401
     exceptions,
     jit_loop,
     jit_purity,
+    lockorder,
     locks,
     markers,
     obs_emit,
     obs_reserved,
     prng,
     retry,
+    trace_escape,
     wallclock,
 )

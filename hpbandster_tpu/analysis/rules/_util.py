@@ -64,7 +64,11 @@ def import_map_for(module) -> "ImportMap":
     """Per-module ImportMap, built once and memoized on the SourceModule."""
     imports = module.cache.get("import_map")
     if imports is None:
-        imports = ImportMap(module.tree, nodes=module.walk())
+        # reuse the memoized preorder walk when some rule already built it,
+        # but don't FORCE it: for graph-context modules that are never
+        # rule-scanned, a plain ast.walk is much cheaper than indexing
+        nodes = module.walk() if "dfs" in module.cache else None
+        imports = ImportMap(module.tree, nodes=nodes)
         module.cache["import_map"] = imports
     return imports
 

@@ -28,7 +28,7 @@ jit sites are declared there.
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Set
+from typing import Dict, List, Set, Tuple
 
 from hpbandster_tpu.analysis.core import Finding, Rule, SourceModule, register
 from hpbandster_tpu.analysis.rules._util import ImportMap, import_map_for
@@ -146,14 +146,30 @@ def traced_functions(
     return traced
 
 
-def traced_functions_for(module) -> Dict[ast.FunctionDef, Set[str]]:
+def traced_functions_for(module, nodes=None) -> Dict[ast.FunctionDef, Set[str]]:
     """Per-module :func:`traced_functions`, built once and memoized on the
-    SourceModule (two rules ask the same question of every module)."""
+    SourceModule (two rules ask the same question of every module).
+
+    ``nodes`` optionally narrows the scan to a pre-collected census — the
+    call graph hands over its per-module FunctionDef/Call list, which is
+    all :func:`traced_functions` ever inspects."""
     traced = module.cache.get("traced_functions")
     if traced is None:
-        traced = traced_functions(
-            module.tree, import_map_for(module), nodes=module.walk()
-        )
+        # cheap text prefilter first: a module whose source never mentions
+        # a trace wrapper cannot define a traced function, and skipping it
+        # here keeps whole-program traced-root discovery (analysis/graph)
+        # from paying a full walk of every call-graph-context module
+        if not any(
+            marker in module.text
+            for marker in ("jit", "pmap", "vmap", "vectorize", "lax.")
+        ):
+            traced = {}
+        else:
+            if nodes is None:
+                nodes = module.walk() if "dfs" in module.cache else None
+            traced = traced_functions(
+                module.tree, import_map_for(module), nodes=nodes
+            )
         module.cache["traced_functions"] = traced
     return traced
 
@@ -216,161 +232,213 @@ class JitHostSyncRule(Rule):
         fn: ast.FunctionDef,
         static: Set[str],
     ) -> List[Finding]:
-        traced: Set[str] = {
-            a.arg
-            for a in (
-                list(fn.args.posonlyargs) + list(fn.args.args) + list(fn.args.kwonlyargs)
+        _, sinks = analyze_body(module, imports, fn, traced_param_seed(fn, static))
+        return [
+            self.finding(
+                module,
+                node,
+                f"{what} on a traced value inside traced function "
+                f"{fn.name!r} forces a host sync (or raises at trace time)",
             )
-            if a.arg not in static and a.arg not in ("self", "cls")
-        }
-        if fn.args.vararg is not None:
-            traced.add(fn.args.vararg.arg)
+            for node, what in sinks
+        ]
 
-        fn_nodes = tuple(module.subtree(fn))
 
-        def refs_traced(node: ast.AST) -> bool:
-            return any(
-                isinstance(n, ast.Name) and n.id in traced
-                for n in module.subtree(node)
-            )
+def traced_param_seed(fn: ast.FunctionDef, static: Set[str]) -> Set[str]:
+    """The parameter names that carry tracers into ``fn``'s body: every
+    non-static parameter except self/cls."""
+    traced: Set[str] = {
+        a.arg
+        for a in (
+            list(fn.args.posonlyargs) + list(fn.args.args) + list(fn.args.kwonlyargs)
+        )
+        if a.arg not in static and a.arg not in ("self", "cls")
+    }
+    if fn.args.vararg is not None:
+        traced.add(fn.args.vararg.arg)
+    return traced
 
-        def taint_target(tgt: ast.expr) -> None:
-            # a subscript store taints the container, never the index names
-            # (`counts[b] = traced` says nothing about `b`)
-            while isinstance(tgt, (ast.Subscript, ast.Starred)):
-                tgt = tgt.value
-            if isinstance(tgt, ast.Name):
-                traced.add(tgt.id)
-            elif isinstance(tgt, (ast.Tuple, ast.List)):
-                for el in tgt.elts:
-                    taint_target(el)
 
-        # two forward passes: assignments referencing traced names taint
-        # their targets (handles use-before-def between helpers once)
-        for _ in range(2):
-            for node in fn_nodes:
-                if isinstance(node, ast.Assign) and refs_traced(node.value):
-                    for tgt in node.targets:
-                        taint_target(tgt)
-                elif isinstance(node, ast.AugAssign) and refs_traced(node.value):
-                    taint_target(node.target)
+def analyze_body(
+    module: SourceModule,
+    imports: ImportMap,
+    fn: ast.FunctionDef,
+    seed: Set[str],
+) -> "Tuple[Set[str], List[Tuple[ast.AST, str]]]":
+    """The taint-and-sink engine behind jit-host-sync, factored out so the
+    interprocedural trace-escape rule can run it per (function, traced
+    parameter set) summary: starting from ``seed`` traced names, propagate
+    taint through assignments and return ``(traced_names, sinks)`` where
+    each sink is ``(node, what)`` — a host-sync applied to a traced value.
+    """
+    traced = set(seed)
+    fn_nodes = tuple(module.subtree(fn))
 
-        findings: List[Finding] = []
+    def refs_traced(node: ast.AST) -> bool:
+        return any(
+            isinstance(n, ast.Name) and n.id in traced
+            for n in module.subtree(node)
+        )
 
-        def flag(node: ast.AST, what: str) -> None:
-            findings.append(
-                self.finding(
-                    module,
-                    node,
-                    f"{what} on a traced value inside traced function "
-                    f"{fn.name!r} forces a host sync (or raises at trace time)",
-                )
-            )
-
-        def cast_arg_traced(node: ast.AST) -> bool:
-            """Can this expression's VALUE be a tracer? Static metadata
-            extractors shield: ``len(x)``, ``x.shape``/``ndim``/``size``/
-            ``dtype`` are concrete at trace time even on a tracer, so
-            ``float(x.shape[0])`` stays legal while ``float(x[0])`` and
-            ``float(x.sum())`` are flagged."""
-            if isinstance(node, ast.Name):
-                return node.id in traced
-            if isinstance(node, ast.Attribute):
-                if node.attr in _STATIC_TRACER_ATTRS:
-                    return False
-                return cast_arg_traced(node.value)
-            if isinstance(node, ast.Subscript):
-                return cast_arg_traced(node.value)
-            if isinstance(node, ast.Call):
-                if isinstance(node.func, ast.Name) and node.func.id == "len":
-                    return False
-                parts = [node.func, *node.args]
-                parts += [kw.value for kw in node.keywords]
-                return any(cast_arg_traced(p) for p in parts)
-            if isinstance(node, ast.BinOp):
-                return cast_arg_traced(node.left) or cast_arg_traced(node.right)
-            if isinstance(node, ast.UnaryOp):
-                return cast_arg_traced(node.operand)
-            # anything else (constants, tuples, comprehensions): quiet —
-            # the rule stays conservative on forms it cannot judge
+    def value_traced(node: ast.AST) -> bool:
+        """Shield-aware ``refs_traced`` for assignment RHS: a value that
+        only reaches traced names through static metadata extractors
+        (``x.shape[0]``, ``len(x)``, ``x.dtype``) is concrete at trace
+        time and must not propagate taint — ``n_rows = x.shape[0]`` then
+        ``if n_rows < n0:`` is legal trace-time shape arithmetic."""
+        if isinstance(node, ast.Name):
+            return node.id in traced
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_TRACER_ATTRS:
+                return False
+            return value_traced(node.value)
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "len"
+        ):
             return False
+        if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+            for op in node.ops
+        ):
+            # identity is a static trace-time fact; membership on a pytree
+            # container (`b in warm_n` on a dict of arrays) is static dict
+            # arithmetic, and on an actual tracer `in` raises LOUDLY at
+            # trace time — either way no silent escape flows out of it
+            return False
+        return any(value_traced(c) for c in ast.iter_child_nodes(node))
 
-        #: BoolOp nodes already judged as an If/While/IfExp/Assert test —
-        #: the owning statement reports them; the generic and/or check
-        #: below must not double-flag the same coercion
-        judged_tests = set()
-        for node in fn_nodes:
-            if isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
-                if isinstance(node.test, ast.BoolOp):
-                    judged_tests.add(id(node.test))
+    def taint_target(tgt: ast.expr) -> None:
+        # a subscript store taints the container, never the index names
+        # (`counts[b] = traced` says nothing about `b`)
+        while isinstance(tgt, (ast.Subscript, ast.Starred)):
+            tgt = tgt.value
+        if isinstance(tgt, ast.Name):
+            traced.add(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                taint_target(el)
 
+    # two forward passes: assignments referencing traced names taint
+    # their targets (handles use-before-def between helpers once)
+    for _ in range(2):
         for node in fn_nodes:
-            if isinstance(node, ast.Call):
-                callee = imports.resolve(node.func)
-                if (
-                    isinstance(node.func, ast.Name)
-                    and node.func.id in _CASTS
-                    and node.args
-                    and cast_arg_traced(node.args[0])
-                ):
-                    flag(node, f"{node.func.id}()")
-                elif (
-                    callee is not None
-                    and node.args
-                    and refs_traced(node.args[0])
-                    and (
-                        callee == "jax.device_get"
-                        or (
-                            callee.startswith(("numpy.", "np."))
-                            and callee.rsplit(".", 1)[-1] in _NUMPY_SINKS
-                        )
-                    )
-                ):
-                    flag(node, callee)
-                elif (
-                    isinstance(node.func, ast.Attribute)
-                    and node.func.attr in _METHOD_SINKS
-                    and refs_traced(node.func.value)
-                ):
-                    flag(node, f".{node.func.attr}()")
-            elif isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
-                # only bare traced names as direct operands: `if x:` /
-                # `if x > 0:` are tracer bool-coercions; `if f(x) ...` is
-                # left alone (f may be static — shape math, trained_split).
-                # IfExp (`a if x else b`) and Assert are the same implicit
-                # __bool__ wearing expression/statement clothes.
-                test = node.test
-                operands: List[ast.expr] = [test]
-                if isinstance(test, ast.Compare):
-                    if all(
-                        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
-                    ):
-                        # `x is None` on a tracer is Python IDENTITY — a
-                        # static trace-time fact, no __bool__ coercion
-                        continue
-                    operands = [test.left, *test.comparators]
-                elif isinstance(test, ast.BoolOp):
-                    operands = list(test.values)
-                elif isinstance(test, ast.UnaryOp):
-                    operands = [test.operand]
-                if any(
-                    isinstance(op, ast.Name) and op.id in traced for op in operands
-                ):
-                    what = (
-                        "Python branch" if isinstance(node, (ast.If, ast.While))
-                        else "conditional expression"
-                        if isinstance(node, ast.IfExp) else "assert"
-                    )
-                    flag(node, what)
+            if isinstance(node, ast.Assign) and value_traced(node.value):
+                for tgt in node.targets:
+                    taint_target(tgt)
+            elif isinstance(node, ast.AugAssign) and value_traced(node.value):
+                taint_target(node.target)
+
+    sinks: List[Tuple[ast.AST, str]] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        sinks.append((node, what))
+
+    def cast_arg_traced(node: ast.AST) -> bool:
+        """Can this expression's VALUE be a tracer? Static metadata
+        extractors shield: ``len(x)``, ``x.shape``/``ndim``/``size``/
+        ``dtype`` are concrete at trace time even on a tracer, so
+        ``float(x.shape[0])`` stays legal while ``float(x[0])`` and
+        ``float(x.sum())`` are flagged."""
+        if isinstance(node, ast.Name):
+            return node.id in traced
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_TRACER_ATTRS:
+                return False
+            return cast_arg_traced(node.value)
+        if isinstance(node, ast.Subscript):
+            return cast_arg_traced(node.value)
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name) and node.func.id == "len":
+                return False
+            parts = [node.func, *node.args]
+            parts += [kw.value for kw in node.keywords]
+            return any(cast_arg_traced(p) for p in parts)
+        if isinstance(node, ast.BinOp):
+            return cast_arg_traced(node.left) or cast_arg_traced(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return cast_arg_traced(node.operand)
+        # anything else (constants, tuples, comprehensions): quiet —
+        # the rule stays conservative on forms it cannot judge
+        return False
+
+    #: BoolOp nodes already judged as an If/While/IfExp/Assert test —
+    #: the owning statement reports them; the generic and/or check
+    #: below must not double-flag the same coercion
+    judged_tests = set()
+    for node in fn_nodes:
+        if isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+            if isinstance(node.test, ast.BoolOp):
+                judged_tests.add(id(node.test))
+
+    for node in fn_nodes:
+        if isinstance(node, ast.Call):
+            callee = imports.resolve(node.func)
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in _CASTS
+                and node.args
+                and cast_arg_traced(node.args[0])
+            ):
+                flag(node, f"{node.func.id}()")
             elif (
-                isinstance(node, ast.BoolOp)
-                and id(node) not in judged_tests
-                and any(
-                    isinstance(v, ast.Name) and v.id in traced
-                    for v in node.values
+                callee is not None
+                and node.args
+                and refs_traced(node.args[0])
+                and (
+                    callee == "jax.device_get"
+                    or (
+                        callee.startswith(("numpy.", "np."))
+                        and callee.rsplit(".", 1)[-1] in _NUMPY_SINKS
+                    )
                 )
             ):
-                # bare `x and y` / `x or y` on a tracer coerces __bool__
-                # exactly like `if x:` — the short-circuit needs a value
-                flag(node, "and/or")
-        return findings
+                flag(node, callee)
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METHOD_SINKS
+                and refs_traced(node.func.value)
+            ):
+                flag(node, f".{node.func.attr}()")
+        elif isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+            # only bare traced names as direct operands: `if x:` /
+            # `if x > 0:` are tracer bool-coercions; `if f(x) ...` is
+            # left alone (f may be static — shape math, trained_split).
+            # IfExp (`a if x else b`) and Assert are the same implicit
+            # __bool__ wearing expression/statement clothes.
+            test = node.test
+            operands: List[ast.expr] = [test]
+            if isinstance(test, ast.Compare):
+                if all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+                ):
+                    # `x is None` on a tracer is Python IDENTITY — a
+                    # static trace-time fact, no __bool__ coercion
+                    continue
+                operands = [test.left, *test.comparators]
+            elif isinstance(test, ast.BoolOp):
+                operands = list(test.values)
+            elif isinstance(test, ast.UnaryOp):
+                operands = [test.operand]
+            if any(
+                isinstance(op, ast.Name) and op.id in traced for op in operands
+            ):
+                what = (
+                    "Python branch" if isinstance(node, (ast.If, ast.While))
+                    else "conditional expression"
+                    if isinstance(node, ast.IfExp) else "assert"
+                )
+                flag(node, what)
+        elif (
+            isinstance(node, ast.BoolOp)
+            and id(node) not in judged_tests
+            and any(
+                isinstance(v, ast.Name) and v.id in traced
+                for v in node.values
+            )
+        ):
+            # bare `x and y` / `x or y` on a tracer coerces __bool__
+            # exactly like `if x:` — the short-circuit needs a value
+            flag(node, "and/or")
+    return traced, sinks

@@ -1,0 +1,270 @@
+"""trace-escape — host syncs and obs emits reached *through* helper calls
+from traced bodies.
+
+``jit-host-sync`` and ``obs-emit-in-jit`` stop at the function boundary:
+a traced body that calls ``self._score(batch)`` looks pure even when
+``_score`` does ``float(x)`` three frames down. This rule walks the
+whole-program call graph (``analysis/graph.py``) from every traced root
+(jit/vmap/pmap-decorated function, or one passed into a wrapper /
+``lax`` combinator) and re-runs the same taint-and-sink engine
+(``jit_purity.analyze_body``) inside each callee, with the callee's
+taint seed derived from which *arguments* were traced at the call site:
+
+* positional and keyword arguments are mapped onto parameter names
+  (bound calls skip the self slot);
+* a callee is analyzed once per distinct traced-parameter set — the
+  per-function summary cache the fast-lane bar depends on;
+* chains are followed to ``_MAX_DEPTH`` call hops (the bounded-depth
+  contract; deeper sinks are out of contract, see
+  docs/static_analysis.md);
+* callees that are themselves traced roots are skipped — they are
+  audited as their own root, and findings would duplicate.
+
+Findings are two-location: the **primary** location is the call site
+inside (or downstream of) the traced body — where the trace boundary is
+breached and where the fix goes — and the **related** location is the
+sink itself (the ``float()``, ``.item()``, ``np.``, branch, or
+``obs.emit``). Suppressions at either location mute the finding.
+
+The obs leg needs no taint: emitting from anywhere beneath a traced body
+fires at trace time (once per compile) regardless of what the arguments
+are, so any chain from a traced root into ``hpbandster_tpu.obs`` call
+machinery is flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from hpbandster_tpu.analysis.core import Finding, ProjectRule, register
+from hpbandster_tpu.analysis.graph import CallSite, FunctionInfo, Project
+from hpbandster_tpu.analysis.rules._util import import_map_for
+from hpbandster_tpu.analysis.rules.jit_purity import (
+    analyze_body,
+    traced_param_seed,
+)
+from hpbandster_tpu.analysis.rules.obs_emit import _OBS_PREFIX
+
+#: call-graph hops followed below a traced body (root body = hop 0)
+_MAX_DEPTH = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class _Sink:
+    """A witnessed escape inside some callee: ``what`` at ``path:line``,
+    reached through ``hops`` call edges from the traced body."""
+
+    what: str
+    path: str
+    line: int
+    hops: int
+
+
+class _EscapeIndex:
+    """Per-project summary caches shared across roots (and across the two
+    legs), keyed so repeated helpers — the common case — analyze once."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        #: (qname, frozen traced params) -> first host-sync sink, or None
+        self.sync_memo: Dict[Tuple[str, FrozenSet[str]], Optional[_Sink]] = {}
+        #: qname -> first obs-call sink, or None (taint-free leg)
+        self.emit_memo: Dict[str, Optional[_Sink]] = {}
+        self.traced_qnames: Set[str] = {
+            info.qname for info, _static in project.traced_roots()
+        }
+
+    # ------------------------------------------------------------ host sync
+    def sync_sink(
+        self, info: FunctionInfo, tainted: FrozenSet[str], depth: int
+    ) -> Optional[_Sink]:
+        """First host-sync sink reachable when ``info`` is entered with
+        ``tainted`` parameters carrying tracers; None when provably clean
+        within the depth budget (or on a cycle — under-approximate)."""
+        key = (info.qname, tainted)
+        if key in self.sync_memo:
+            return self.sync_memo[key]
+        self.sync_memo[key] = None  # cycle guard: in-progress reads as clean
+        sink = self._sync_sink_uncached(info, tainted, depth)
+        self.sync_memo[key] = sink
+        return sink
+
+    def _sync_sink_uncached(
+        self, info: FunctionInfo, tainted: FrozenSet[str], depth: int
+    ) -> Optional[_Sink]:
+        module = info.module
+        traced, sinks = analyze_body(
+            module, import_map_for(module), info.node, set(tainted)
+        )
+        if sinks:
+            node, what = sinks[0]
+            return _Sink(what, module.path, node.lineno, 1)
+        if depth >= _MAX_DEPTH:
+            return None
+        for site in self.project.callees(info.qname):
+            if site.via_partial or site.callee.qname in self.traced_qnames:
+                continue
+            sub = _tainted_params(site, traced)
+            if not sub:
+                continue
+            found = self.sync_sink(site.callee, frozenset(sub), depth + 1)
+            if found is not None:
+                return dataclasses.replace(found, hops=found.hops + 1)
+        return None
+
+    # ------------------------------------------------------------- obs emit
+    def emit_sink(self, info: FunctionInfo, depth: int) -> Optional[_Sink]:
+        """First call into ``hpbandster_tpu.obs`` machinery reachable from
+        ``info`` — no taint required, trace-time execution is the bug."""
+        if info.qname in self.emit_memo:
+            return self.emit_memo[info.qname]
+        self.emit_memo[info.qname] = None
+        sink = self._emit_sink_uncached(info, depth)
+        self.emit_memo[info.qname] = sink
+        return sink
+
+    def _emit_sink_uncached(self, info: FunctionInfo, depth: int) -> Optional[_Sink]:
+        module = info.module
+        imports = import_map_for(module)
+        for node in self.project.fn_calls.get(info.qname, ()):
+            resolved = imports.resolve(node.func) or ""
+            if resolved.startswith(_OBS_PREFIX):
+                return _Sink(ast.unparse(node.func) + "()", module.path, node.lineno, 1)
+        if depth >= _MAX_DEPTH:
+            return None
+        for site in self.project.callees(info.qname):
+            callee = site.callee
+            if site.via_partial or callee.qname in self.traced_qnames:
+                continue
+            if callee.qname.startswith(_OBS_PREFIX + "."):
+                return _Sink(
+                    f"{callee.qname.rsplit('.', 1)[-1]}()",
+                    module.path,
+                    site.line,
+                    1,
+                )
+            found = self.emit_sink(callee, depth + 1)
+            if found is not None:
+                return dataclasses.replace(found, hops=found.hops + 1)
+        return None
+
+
+def _escape_index(project: Project) -> _EscapeIndex:
+    index = project.cache.get("trace_escape")
+    if index is None:
+        index = _EscapeIndex(project)
+        project.cache["trace_escape"] = index
+    return index
+
+
+def _tainted_params(site: CallSite, traced: Set[str]) -> Set[str]:
+    """Callee parameter names that receive a traced value at ``site``."""
+
+    def is_traced(expr: ast.AST) -> bool:
+        return any(
+            isinstance(n, ast.Name) and n.id in traced for n in ast.walk(expr)
+        )
+
+    callee = site.callee
+    params = callee.positional_params(site.bound)
+    out: Set[str] = set()
+    for idx, arg in enumerate(site.node.args):
+        if isinstance(arg, ast.Starred):
+            if is_traced(arg.value) and callee.has_vararg:
+                out.update(params[idx:])
+            continue
+        if not is_traced(arg):
+            continue
+        if idx < len(params):
+            out.add(params[idx])
+        elif callee.has_vararg:
+            out.add("*")  # lands in the vararg; seed every remaining slot
+            out.update(params[idx:])
+    for kw in site.node.keywords:
+        if not is_traced(kw.value):
+            continue
+        if kw.arg is None:  # **kwargs splat: could land anywhere
+            out.update(params)
+            out.update(callee.kwonly)
+        elif kw.arg in params or kw.arg in callee.kwonly or callee.has_kwarg:
+            out.add(kw.arg)
+    out.discard("*")
+    return out
+
+
+@register
+class TraceEscapeRule(ProjectRule):
+    name = "trace-escape"
+    description = (
+        "host sync or obs emission reached through helper calls from a "
+        "jit/vmap/pmap-traced body — invisible to the intraprocedural rules"
+    )
+
+    def check_project(self, project: Project) -> List[Finding]:
+        index = _escape_index(project)
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int, str]] = set()
+
+        for info, static in project.traced_roots():
+            module = info.module
+            seed = traced_param_seed(info.node, static)
+            traced, _root_sinks = analyze_body(
+                module, import_map_for(module), info.node, seed
+            )  # root-level sinks belong to jit-host-sync — not re-reported
+            for site in project.callees(info.qname):
+                if site.via_partial or site.callee.qname in index.traced_qnames:
+                    continue
+                callee = site.callee
+                tainted = _tainted_params(site, traced)
+                if tainted:
+                    sink = index.sync_sink(callee, frozenset(tainted), 1)
+                    if sink is not None:
+                        key = (module.path, site.line, "sync")
+                        if key not in seen:
+                            seen.add(key)
+                            findings.append(
+                                Finding(
+                                    rule=self.name,
+                                    path=module.path,
+                                    line=site.line,
+                                    message=(
+                                        f"traced value escapes {info.name!r} through "
+                                        f"{callee.name!r}: {sink.what} "
+                                        f"{sink.hops} call(s) down forces a host "
+                                        "sync inside the trace — hoist the host "
+                                        "work out of the traced body"
+                                    ),
+                                    related_path=sink.path,
+                                    related_line=sink.line,
+                                    related_note=f"{sink.what} happens here",
+                                )
+                            )
+                emit = (
+                    _Sink(f"{callee.name}()", module.path, site.line, 1)
+                    if callee.qname.startswith(_OBS_PREFIX + ".")
+                    else index.emit_sink(callee, 1)
+                )
+                if emit is not None:
+                    key = (module.path, site.line, "emit")
+                    if key not in seen:
+                        seen.add(key)
+                        findings.append(
+                            Finding(
+                                rule=self.name,
+                                path=module.path,
+                                line=site.line,
+                                message=(
+                                    f"call into {callee.name!r} from traced body "
+                                    f"{info.name!r} reaches obs emission "
+                                    f"({emit.what}) — fires at trace time, once "
+                                    "per compile, not per execution; emit around "
+                                    "the jit boundary"
+                                ),
+                                related_path=emit.path,
+                                related_line=emit.line,
+                                related_note=f"{emit.what} happens here",
+                            )
+                        )
+        return findings

@@ -1006,6 +1006,12 @@ def make_fused_sweep_fn(
 
     def trained_split(n: int) -> Optional[Tuple[int, int]]:
         """Host-side static twin of the _fit_kde_pair gate."""
+        # run_bracket reaches this only on the static tier
+        # (dynamic_counts=False), where counts[b] are Python ints burned
+        # into the trace; the traced-counts tier routes to dynamic_gate,
+        # the i32 twin of this gate. The tier split is a closure constant
+        # a path-insensitive analysis cannot correlate.
+        # graftlint: disable=trace-escape — static-tier-only host gate (see above)
         if n < min_pts + 2:
             return None
         n_good = max(min_pts, (top_n_percent * n) // 100)

@@ -566,7 +566,14 @@ class ContinuousRunner:
         with self._lock:
             if self._carry is None:
                 return [None] * self.lane_count
-            return decode_lane_state(jax.device_get(self._carry))
+            # snapshot the carry reference only: device_get blocks until
+            # the in-flight chunk producing it finishes on device, and
+            # holding the lock through that stalls every join/leave/submit
+            # on this runner behind an inspection call. Fetching outside
+            # is safe — carries are immutable; a racing chunk swaps the
+            # reference, it never mutates the fetched one.
+            carry = self._carry
+        return decode_lane_state(jax.device_get(carry))
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:

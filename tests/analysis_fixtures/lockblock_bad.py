@@ -1,0 +1,48 @@
+"""Known-BAD fixture for the lock-blocking rule: blocking operations
+reached while holding a lock — directly, and through the call graph."""
+
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._thread = threading.Thread(target=print)
+
+    def nap(self):
+        with self._lock:
+            time.sleep(0.1)  # BAD
+
+    def _drain(self):
+        self._thread.join()
+
+    def stop(self):
+        with self._lock:
+            self._drain()  # BAD
+
+    def fetch(self, sock):
+        with self._lock:
+            return sock.recv(1024)  # BAD
+
+    def wait_wrong(self):
+        with self._lock:
+            with self._cond:
+                self._cond.wait()  # BAD
+
+
+def _sync(carry):
+    import jax
+
+    return jax.device_get(carry)
+
+
+class Runner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._carry = None
+
+    def snapshot(self):
+        with self._lock:
+            return _sync(self._carry)  # BAD

@@ -1,0 +1,51 @@
+"""Known-GOOD fixture for the lock-blocking rule: the sanctioned idioms —
+condition waits, snapshot-then-call, string/path joins, and one justified
+suppression."""
+
+import os
+import threading
+import time
+
+
+class Queue:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.items = []
+
+    def get(self):
+        with self._cond:
+            # waiting on the condition we hold RELEASES it — the idiom
+            self._cond.wait()
+            return self.items.pop()
+
+
+class Pool:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.workers = []
+
+    def stop_all(self):
+        # snapshot under the lock, block outside it
+        with self._lock:
+            workers = list(self.workers)
+            self.workers = []
+        for w in workers:
+            w.join()
+
+    def manifest(self, parts):
+        with self._lock:
+            # rope and filesystem paths, not threads
+            name = "-".join(parts)
+            return os.path.join("/tmp", name)
+
+    def brief_backoff(self):
+        with self._lock:
+            # justified: the probe lock is uncontended by construction
+            # (single writer), and the 1ms settle is load-bearing for the
+            # flaky-NFS retry it guards
+            time.sleep(0.001)  # graftlint: disable=lock-blocking — uncontended settle
+
+
+def poll(sock):
+    # blocking I/O with no lock held is fine
+    return sock.recv(4096)

@@ -1,0 +1,61 @@
+"""Known-BAD fixture for the lock-order rule: acquisition-order cycles and
+re-acquisition of non-reentrant locks, direct and through the call graph."""
+
+import threading
+
+GATE = threading.Lock()
+
+
+class Replayer:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def flush(self):
+        with self._lock:
+            with self._lock:  # BAD
+                pass
+
+    def _append(self, item):
+        with self._lock:
+            return item
+
+    def submit(self, item):
+        with self._lock:
+            self._append(item)  # BAD
+
+
+class Duo:
+    def __init__(self):
+        self._alpha = threading.Lock()
+        self._beta = threading.Lock()
+
+    def forward(self):
+        with self._alpha:
+            with self._beta:  # BAD
+                pass
+
+    def backward(self):
+        with self._beta:
+            with self._alpha:
+                pass
+
+
+def _under_gate():
+    with GATE:
+        pass
+
+
+class Mixer:
+    """Opposite orders where one direction only exists through a call."""
+
+    def __init__(self):
+        self._m = threading.Lock()
+
+    def m_then_gate(self):
+        with self._m:
+            _under_gate()
+
+    def gate_then_m(self):
+        with GATE:
+            with self._m:  # BAD
+                pass

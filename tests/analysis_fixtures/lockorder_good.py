@@ -1,0 +1,67 @@
+"""Known-GOOD fixture for the lock-order rule: the sanctioned idioms —
+reentrant re-acquisition, globally consistent ordering, and one justified
+(suppressed) deliberate inversion."""
+
+import threading
+
+
+class Recursive:
+    """RLock / default Condition re-entry is legal, directly or nested."""
+
+    def __init__(self):
+        self._rlock = threading.RLock()
+        self._cond = threading.Condition()
+
+    def outer(self):
+        with self._rlock:
+            self.inner()
+
+    def inner(self):
+        with self._rlock:
+            pass
+
+    def notify(self):
+        with self._cond:
+            with self._cond:
+                self._cond.notify_all()
+
+
+class Ordered:
+    """Both paths take _first then _second — consistent order, no cycle."""
+
+    def __init__(self):
+        self._first = threading.Lock()
+        self._second = threading.Lock()
+
+    def path_a(self):
+        with self._first:
+            with self._second:
+                pass
+
+    def path_b(self):
+        with self._first:
+            self._tail()
+
+    def _tail(self):
+        with self._second:
+            pass
+
+
+class Inverted:
+    """A deliberate inversion, justified and suppressed at both witnesses:
+    the teardown path is single-threaded by construction (callers have
+    already joined every worker), so the inverted order cannot race."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def runtime(self):
+        with self._a:
+            with self._b:  # graftlint: disable=lock-order — teardown inversion is single-threaded
+                pass
+
+    def teardown(self):
+        with self._b:
+            with self._a:  # graftlint: disable=lock-order — teardown inversion is single-threaded
+                pass

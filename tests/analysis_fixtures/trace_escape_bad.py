@@ -1,0 +1,38 @@
+"""Known-BAD fixture for the trace-escape rule: host syncs and obs
+emission reached *through* helper calls from traced bodies — invisible to
+the intraprocedural jit-host-sync / obs-emit-in-jit rules."""
+
+import jax
+import jax.numpy as jnp
+
+from hpbandster_tpu.obs import emit
+
+
+def _to_host(v):
+    return float(v)
+
+
+def _norm(v):
+    # no sink here — the escape is one more hop down
+    return _to_host(v) + 1.0
+
+
+def _log_step(tag):
+    emit("fixture.step", tag=tag)
+
+
+@jax.jit
+def step(x):
+    y = jnp.sum(x)
+    z = _norm(y)  # BAD
+    _log_step("step")  # BAD
+    return z
+
+
+def _resolve(v, table):
+    return table[int(v)]
+
+
+@jax.jit
+def lookup(ix, table):
+    return _resolve(ix, table)  # BAD

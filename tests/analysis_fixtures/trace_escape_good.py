@@ -1,0 +1,50 @@
+"""Known-GOOD fixture for the trace-escape rule: the sanctioned idioms —
+static-metadata helpers, host work outside the boundary, membership on
+pytree dicts, and one justified suppression."""
+
+import jax
+import jax.numpy as jnp
+
+from hpbandster_tpu.obs import emit
+
+
+def _row_count(v):
+    # shape/ndim/size/dtype are trace-time METADATA, concrete on tracers
+    return v.shape[0]
+
+
+def _pad_to(n, block):
+    return (n + block - 1) // block * block
+
+
+def _host_norm(v):
+    return float(v)
+
+
+@jax.jit
+def step(x):
+    n = _row_count(x)
+    m = _pad_to(n, 8)
+    return jnp.sum(x) * m
+
+
+@jax.jit
+def gated(x, cfg):
+    # membership on the config pytree is static dict arithmetic
+    if "bias" in cfg:
+        x = x + cfg["bias"]
+    return x
+
+
+def run(x):
+    # host side of the boundary: sync + emit AFTER the jitted call
+    y = step(x)
+    emit("fixture.done", rows=_row_count(y))
+    return _host_norm(jnp.sum(y))
+
+
+@jax.jit
+def debug_step(x):
+    # justified: compiled only in the --debug path, where the sync is the
+    # point (numerical comparison against the host reference)
+    return _host_norm(jnp.sum(x))  # graftlint: disable=trace-escape — debug-only reference path

@@ -1,0 +1,287 @@
+"""Unit tests for the whole-program call graph (analysis/graph.py):
+resolution through imports, aliases, methods, constructor-pinned types,
+``functools.partial``, and enclosing-scope (closure-sibling) locals —
+the edges every interprocedural rule is built on."""
+
+import textwrap
+
+import pytest
+
+from hpbandster_tpu.analysis import graph as graph_mod
+
+
+def build(tmp_path, files):
+    """Write ``{relpath: source}`` under tmp_path and build the Project."""
+    paths = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        paths.append(str(p))
+    # every package dir needs an __init__ for dotted-name derivation
+    for p in list(tmp_path.rglob("*")):
+        if p.is_dir() and not (p / "__init__.py").exists():
+            init = p / "__init__.py"
+            init.write_text("")
+            paths.append(str(init))
+    return graph_mod.get_project(paths)
+
+
+def edge_pairs(project):
+    return {
+        (site.caller, site.callee.qname, site.via_partial)
+        for sites in project.calls.values()
+        for site in sites
+    }
+
+
+class TestResolution:
+    def test_module_local_call(self, tmp_path):
+        project = build(
+            tmp_path,
+            {
+                "m.py": """
+                def helper():
+                    pass
+
+                def entry():
+                    helper()
+                """
+            },
+        )
+        assert ("m.entry", "m.helper", False) in edge_pairs(project)
+
+    def test_from_import_and_module_alias(self, tmp_path):
+        project = build(
+            tmp_path,
+            {
+                "pkg/a.py": """
+                def helper():
+                    pass
+                """,
+                "pkg/b.py": """
+                from pkg.a import helper
+                import pkg.a as aa
+
+                def direct():
+                    helper()
+
+                def via_alias():
+                    aa.helper()
+                """,
+            },
+        )
+        pairs = edge_pairs(project)
+        assert ("pkg.b.direct", "pkg.a.helper", False) in pairs
+        assert ("pkg.b.via_alias", "pkg.a.helper", False) in pairs
+
+    def test_renamed_from_import(self, tmp_path):
+        project = build(
+            tmp_path,
+            {
+                "pkg/a.py": """
+                def helper():
+                    pass
+                """,
+                "pkg/c.py": """
+                from pkg.a import helper as h
+
+                def entry():
+                    h()
+                """,
+            },
+        )
+        assert ("pkg.c.entry", "pkg.a.helper", False) in edge_pairs(project)
+
+    def test_self_method_and_base_class(self, tmp_path):
+        project = build(
+            tmp_path,
+            {
+                "m.py": """
+                class Base:
+                    def shared(self):
+                        pass
+
+                class Child(Base):
+                    def run(self):
+                        self.shared()
+                """
+            },
+        )
+        pairs = edge_pairs(project)
+        assert ("m.Child.run", "m.Base.shared", False) in pairs
+
+    def test_constructor_pinned_receiver(self, tmp_path):
+        project = build(
+            tmp_path,
+            {
+                "pkg/svc.py": """
+                class Service:
+                    def ping(self):
+                        pass
+                """,
+                "pkg/use.py": """
+                from pkg.svc import Service
+
+                def entry():
+                    s = Service()
+                    s.ping()
+                """,
+            },
+        )
+        pairs = edge_pairs(project)
+        assert ("pkg.use.entry", "pkg.svc.Service.ping", False) in pairs
+
+    def test_self_attr_pinned_in_init(self, tmp_path):
+        project = build(
+            tmp_path,
+            {
+                "m.py": """
+                class Worker:
+                    def work(self):
+                        pass
+
+                class Owner:
+                    def __init__(self):
+                        self.w = Worker()
+
+                    def run(self):
+                        self.w.work()
+                """
+            },
+        )
+        assert ("m.Owner.run", "m.Worker.work", False) in edge_pairs(project)
+
+    def test_functools_partial_edge_is_flagged(self, tmp_path):
+        project = build(
+            tmp_path,
+            {
+                "m.py": """
+                import functools
+
+                def target(x):
+                    pass
+
+                def entry():
+                    return functools.partial(target, 1)
+                """
+            },
+        )
+        assert ("m.entry", "m.target", True) in edge_pairs(project)
+
+    def test_closure_siblings_resolve(self, tmp_path):
+        """The jit-factory idiom: a factory defines sibling locals and one
+        calls the other — the edge must exist with <locals> qnames."""
+        project = build(
+            tmp_path,
+            {
+                "m.py": """
+                def make(n):
+                    def helper(x):
+                        return x + n
+
+                    def body(x):
+                        return helper(x)
+
+                    return body
+                """
+            },
+        )
+        assert (
+            "m.make.<locals>.body",
+            "m.make.<locals>.helper",
+            False,
+        ) in edge_pairs(project)
+
+    def test_dynamic_dispatch_resolves_to_nothing(self, tmp_path):
+        """Under-approximation contract: a stored callable produces no
+        edge (a missing edge hides, never invents)."""
+        project = build(
+            tmp_path,
+            {
+                "m.py": """
+                def entry(callback):
+                    callback()
+                """
+            },
+        )
+        assert edge_pairs(project) == set()
+
+
+class TestQueries:
+    def test_reachable_transitive(self, tmp_path):
+        project = build(
+            tmp_path,
+            {
+                "m.py": """
+                def c():
+                    pass
+
+                def b():
+                    c()
+
+                def a():
+                    b()
+                """
+            },
+        )
+        assert {"m.a", "m.b", "m.c"} <= project.reachable(["m.a"])
+        assert "m.a" not in project.reachable(["m.b"])
+
+    def test_lock_declarations(self, tmp_path):
+        project = build(
+            tmp_path,
+            {
+                "m.py": """
+                import threading
+
+                GATE = threading.Lock()
+
+                class Box:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._rlock = threading.RLock()
+                        self._cond = threading.Condition(threading.Lock())
+                """
+            },
+        )
+        locks = project.locks
+        assert locks["m.GATE"].reentrant is False
+        assert locks["m.Box._lock"].reentrant is False
+        assert locks["m.Box._rlock"].reentrant is True
+        # Condition over an explicit Lock is NOT reentrant
+        assert locks["m.Box._cond"].reentrant is False
+        assert project.lock_for_attr("m.Box", "_lock") == "m.Box._lock"
+
+    def test_traced_roots_found(self, tmp_path):
+        project = build(
+            tmp_path,
+            {
+                "m.py": """
+                import jax
+
+                @jax.jit
+                def step(x):
+                    return x
+
+                def plain(x):
+                    return x
+                """
+            },
+        )
+        roots = {info.qname for info, _static in project.traced_roots()}
+        assert "m.step" in roots
+        assert "m.plain" not in roots
+
+
+class TestCaching:
+    def test_project_memoized_until_edit(self, tmp_path):
+        p = tmp_path / "m.py"
+        p.write_text("def f():\n    pass\n")
+        first = graph_mod.get_project([str(p)])
+        assert graph_mod.get_project([str(p)]) is first
+        # an edit (different size => different stat key) invalidates
+        p.write_text("def f():\n    pass\n\ndef g():\n    f()\n")
+        second = graph_mod.get_project([str(p)])
+        assert second is not first
+        assert ("m.g", "m.f", False) in edge_pairs(second)

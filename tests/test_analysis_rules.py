@@ -28,6 +28,9 @@ CASES = [
     ("jit-donation", "donation_bad.py", "donation_good.py"),
     ("wallclock-duration", "wallclock_bad.py", "wallclock_good.py"),
     ("retry-backoff", "retry_bad.py", "retry_good.py"),
+    ("lock-order", "lockorder_bad.py", "lockorder_good.py"),
+    ("lock-blocking", "lockblock_bad.py", "lockblock_good.py"),
+    ("trace-escape", "trace_escape_bad.py", "trace_escape_good.py"),
 ]
 
 
@@ -163,3 +166,155 @@ class TestRunner:
         findings = run(["definitely/not/a/path"])
         assert [f.rule for f in findings] == ["parse-error"]
         assert "does not exist" in findings[0].message
+
+
+class TestLockBlockingRegressions:
+    """serve/continuous.py shipped ``lane_incumbents()`` fetching the lane
+    carry with ``jax.device_get`` while holding the runner lock — every
+    tenant join/leave/submit on the runner queued behind an inspection
+    call until the in-flight chunk finished on device. The fix snapshots
+    the carry reference under the lock and fetches outside. These tests
+    pin the clean state AND the detector that found the bug."""
+
+    CONTINUOUS = (
+        Path(__file__).parent.parent / "hpbandster_tpu" / "serve" / "continuous.py"
+    )
+
+    def test_continuous_runner_is_lock_clean(self):
+        findings = run(
+            [str(self.CONTINUOUS)], rules=["lock-blocking", "lock-order"]
+        )
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+    def test_device_get_under_lock_is_detected(self, tmp_path):
+        # the exact shape of the original bug
+        mod = tmp_path / "runner.py"
+        mod.write_text(
+            "import threading\n"
+            "import jax\n"
+            "\n"
+            "class Runner:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._carry = None\n"
+            "\n"
+            "    def lane_incumbents(self):\n"
+            "        with self._lock:\n"
+            "            return jax.device_get(self._carry)\n"
+        )
+        findings = run([str(mod)], rules=["lock-blocking"])
+        assert len(findings) == 1, "\n".join(str(f) for f in findings)
+        assert "jax.device_get()" in findings[0].message
+        assert findings[0].line == 11
+
+    def test_snapshot_then_fetch_is_clean(self, tmp_path):
+        # the shape of the fix
+        mod = tmp_path / "runner.py"
+        mod.write_text(
+            "import threading\n"
+            "import jax\n"
+            "\n"
+            "class Runner:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._carry = None\n"
+            "\n"
+            "    def lane_incumbents(self):\n"
+            "        with self._lock:\n"
+            "            carry = self._carry\n"
+            "        return jax.device_get(carry)\n"
+        )
+        assert run([str(mod)], rules=["lock-blocking"]) == []
+
+
+class TestTraceEscapeEngine:
+    """Regressions for engine bugs the interprocedural pass exposed in the
+    shared taint machinery (jit_purity.analyze_body)."""
+
+    def test_shape_metadata_does_not_taint_through_assignment(self, tmp_path):
+        # ops/fused.py FP: `n_rows = vectors.shape[0]` must NOT taint
+        # n_rows — shape is trace-time metadata, and branching on it in a
+        # helper is legal static shape arithmetic
+        mod = tmp_path / "m.py"
+        mod.write_text(
+            "import jax\n"
+            "\n"
+            "def _check(n):\n"
+            "    if n < 2:\n"
+            "        raise ValueError('too small')\n"
+            "\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    n_rows = x.shape[0]\n"
+            "    _check(n_rows)\n"
+            "    return x\n"
+        )
+        assert run([str(mod)], rules=["trace-escape"]) == []
+
+    def test_data_derived_value_still_taints(self, tmp_path):
+        # counterpart: the same helper reached with actual device data
+        mod = tmp_path / "m.py"
+        mod.write_text(
+            "import jax\n"
+            "\n"
+            "def _check(n):\n"
+            "    if n < 2:\n"
+            "        raise ValueError('too small')\n"
+            "\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    first = x[0]\n"
+            "    _check(first)\n"
+            "    return x\n"
+        )
+        findings = run([str(mod)], rules=["trace-escape"])
+        assert len(findings) == 1
+        assert findings[0].line == 10
+
+    def test_membership_compare_is_static(self, tmp_path):
+        # ops/sweep.py FP: `have = warm is not None and 0 in warm` —
+        # identity and membership are static trace-time facts (on a real
+        # tracer `in` raises loudly); `have` must not become traced
+        mod = tmp_path / "m.py"
+        mod.write_text(
+            "import jax\n"
+            "\n"
+            "@jax.jit\n"
+            "def init(x, warm):\n"
+            "    have = warm is not None and 0 in warm\n"
+            "    return x + 1 if have else x\n"
+        )
+        assert run([str(mod)], rules=["jit-host-sync", "trace-escape"]) == []
+
+    def test_two_hop_escape_found_with_sink_location(self, tmp_path):
+        mod = tmp_path / "m.py"
+        mod.write_text(
+            "import jax\n"
+            "\n"
+            "def _inner(v):\n"
+            "    return float(v)\n"
+            "\n"
+            "def _outer(v):\n"
+            "    return _inner(v) + 1.0\n"
+            "\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    return _outer(x)\n"
+        )
+        findings = run([str(mod)], rules=["trace-escape"])
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.line == 11  # primary: the escape call site in the root
+        assert f.related_line == 4  # related: the float() sink itself
+        assert "2 call(s) down" in f.message
+
+    def test_escape_beyond_depth_budget_is_out_of_contract(self, tmp_path):
+        # bounded-depth contract: a sink _MAX_DEPTH+1 hops down is not
+        # reported (documented under-approximation, not a bug)
+        chain = ["import jax\n\n", "def h5(v):\n    return float(v)\n\n"]
+        for i in range(4, 0, -1):
+            chain.append(f"def h{i}(v):\n    return h{i + 1}(v)\n\n")
+        chain.append("@jax.jit\ndef step(x):\n    return h1(x)\n")
+        mod = tmp_path / "m.py"
+        mod.write_text("".join(chain))
+        assert run([str(mod)], rules=["trace-escape"]) == []

@@ -29,6 +29,9 @@ RULE_TO_BAD_FIXTURE = {
     "obs-reserved-fields": "obs_reserved_bad.py",
     "jit-in-loop": "jit_loop_bad.py",
     "jit-donation": "donation_bad.py",
+    "lock-order": "lockorder_bad.py",
+    "lock-blocking": "lockblock_bad.py",
+    "trace-escape": "trace_escape_bad.py",
 }
 
 
@@ -103,3 +106,130 @@ def test_selfcheck_is_fast_lane_material():
     run(SCAN)
     elapsed = time.perf_counter() - t0
     assert elapsed < 5.0, f"graftlint scan took {elapsed:.2f}s"
+
+
+def test_interprocedural_scan_is_cold_fast():
+    """Perf guard for the interprocedural pass specifically: a genuinely
+    COLD full scan (module + project caches dropped) of both trees, all
+    rules including the call-graph ones, stays under the 5 s fast-lane
+    budget."""
+    from hpbandster_tpu.analysis import graph
+
+    graph.clear_caches()
+    t0 = time.perf_counter()
+    findings = run(SCAN)
+    elapsed = time.perf_counter() - t0
+    assert findings == []
+    assert elapsed < 5.0, f"cold interprocedural scan took {elapsed:.2f}s"
+
+
+@pytest.mark.slow
+def test_changed_mode_single_file_is_fast():
+    """The pre-commit latency bar: a cold CLI invocation scanning one
+    changed source file against the whole-program graph in under 1.5 s
+    (interpreter startup included)."""
+    import subprocess
+    import sys
+
+    target = str(REPO / "hpbandster_tpu" / "serve" / "continuous.py")
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-m", "hpbandster_tpu.analysis", "--changed", target],
+        cwd=str(REPO),
+        capture_output=True,
+        text=True,
+    )
+    elapsed = time.perf_counter() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert elapsed < 1.5, f"--changed scan took {elapsed:.2f}s"
+
+
+class TestCliFormats:
+    def test_json_format(self, tmp_path, capsys):
+        bad = tmp_path / "lockblock_bad.py"
+        shutil.copy(FIXTURES / "lockblock_bad.py", bad)
+        assert main(["--format=json", str(bad)]) == 1
+        rows = __import__("json").loads(capsys.readouterr().out)
+        assert any(r["rule"] == "lock-blocking" for r in rows)
+        # two-location findings carry the sink as a related location
+        related = [r for r in rows if "related" in r]
+        assert related, "no two-location finding in lockblock_bad.py?"
+        assert related[0]["related"]["line"] > 0
+
+    def test_sarif_format(self, tmp_path, capsys):
+        bad = tmp_path / "trace_escape_bad.py"
+        shutil.copy(FIXTURES / "trace_escape_bad.py", bad)
+        assert main(["--format=sarif", str(bad)]) == 1
+        sarif = __import__("json").loads(capsys.readouterr().out)
+        assert sarif["version"] == "2.1.0"
+        results = sarif["runs"][0]["results"]
+        assert any(r["ruleId"] == "trace-escape" for r in results)
+        assert any("relatedLocations" in r for r in results)
+
+    def test_sarif_clean_tree_is_valid_and_empty(self, tmp_path, capsys):
+        mod = tmp_path / "ok.py"
+        mod.write_text("def f():\n    return 1\n")
+        assert main(["--format=sarif", str(mod)]) == 0
+        sarif = __import__("json").loads(capsys.readouterr().out)
+        assert sarif["runs"][0]["results"] == []
+
+
+class TestBaselineRatchet:
+    def test_baseline_tolerates_frozen_then_gates_new(self, tmp_path, capsys):
+        tree = tmp_path / "tree"
+        tree.mkdir()
+        shutil.copy(FIXTURES / "lockblock_bad.py", tree / "legacy.py")
+        baseline = tmp_path / "baseline.json"
+
+        # freeze the legacy findings
+        assert main([str(tree), "--write-baseline", str(baseline)]) == 0
+        capsys.readouterr()
+
+        # frozen tree passes under the baseline
+        assert main([str(tree), "--baseline", str(baseline)]) == 0
+        capsys.readouterr()
+
+        # a NEW finding still gates
+        shutil.copy(FIXTURES / "lockorder_bad.py", tree / "fresh.py")
+        assert main([str(tree), "--baseline", str(baseline)]) == 1
+        out = capsys.readouterr().out
+        assert "[lock-order]" in out
+        # ...and the frozen legacy findings stay muted
+        assert "legacy.py" not in out
+
+    def test_unreadable_baseline_is_usage_error(self, tmp_path, capsys):
+        assert main([str(tmp_path), "--baseline", str(tmp_path / "nope.json")]) == 2
+
+
+class TestChangedMode:
+    def test_changed_clean_file_exits_zero(self, capsys):
+        target = str(REPO / "hpbandster_tpu" / "analysis" / "core.py")
+        assert main(["--changed", target]) == 0
+
+    def test_changed_missing_path_is_usage_error(self, capsys):
+        assert main(["--changed", "no/such/file.py"]) == 2
+
+    def test_changed_still_sees_cross_module_callees(self, tmp_path, capsys):
+        """The point of --changed: the reported file calls a helper whose
+        sink lives in an UNCHANGED sibling — the finding must still
+        surface, anchored in the changed file."""
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "helpers.py").write_text(
+            "def to_host(v):\n    return float(v)\n"
+        )
+        (pkg / "entry.py").write_text(
+            "import jax\n"
+            "from pkg.helpers import to_host\n"
+            "\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    return to_host(x)\n"
+        )
+        findings = run(
+            [str(pkg / "entry.py")], graph_roots=[str(pkg)], rules=["trace-escape"]
+        )
+        assert len(findings) == 1
+        assert findings[0].path == str(pkg / "entry.py")
+        assert findings[0].related_path == str(pkg / "helpers.py")
