@@ -65,7 +65,8 @@ HEADLINE_BRACKETS = 27
 #: measured on a TPU; the headline fused/rpc pair has (BENCH_r02.json)
 TIER_ORDER = (
     "cnn", "cnn_wide", "pallas", "resnet", "transformer", "fused_1M",
-    "fused_100k", "resident_100k", "fused10k", "chunked10k",
+    "fused_100k", "resident_100k", "ensemble_smoke", "fused10k",
+    "chunked10k",
     "chunked_compile", "fused",
     "rpc", "batched", "teacher", "multitenant", "serve_continuous",
     "chaos", "async_straggler", "obs_overhead",
@@ -560,6 +561,178 @@ def bench_resident_sharded(sizes=(1 << 13, 1 << 17), n_brackets=3,
             "CPU-measured: directional; re-measure (and the Pallas fit "
             "twin) on the next TPU window" if cpu_fallback else
             "accelerator-measured"
+        ),
+    }
+
+
+def bench_ensemble_smoke(n_configs=256, n_brackets=2, max_budget=9,
+                         repeats=3, seed=0, resident_sizes=(256, 512)):
+    """``ensemble_smoke``: REAL-MODEL training under the fused sweep — the
+    r02-era "workloads skipped on CPU" gap, closed. One device dispatch
+    trains a whole rung of MLPs (``workloads/ensemble.py``: vmapped SGD,
+    budget = cumulative steps, warm continuation across rungs), sized so
+    the fallback path measures it in seconds.
+
+    Two arms:
+
+    - **unrolled**, via ``make_fused_sweep_fn(stateful_eval=...)``
+      AOT-compiled (``lower().compile()``) so XLA's cost analysis lands in
+      the compile ledger — then ``obs.profile.roofline_report`` must
+      CLASSIFY the ensemble program (flops + intensity; bound when the
+      device has a peak table entry, the CPU no-peak caveat otherwise).
+      This is the first compute-heavy program through PR 7's roofline
+      path: the surrogate sweeps it measured before are all bookkeeping.
+    - **resident**, via ``run_sharded_fused_sweep(resident=True,
+      stateful_eval=...)`` at two config counts — the per-sweep
+      (d2h, h2d, host_syncs) bill must be IDENTICAL across sizes: live
+      model state is bracket-local device scratch, so the flat host-link
+      contract survives real training (asserted, not prose).
+
+    Both arms train >= 256 configs in the first rung (the ISSUE 17
+    acceptance bar) at default arguments; the per-lane memory formula
+    (``ensemble_lane_bytes``) lands in the tier dict as the number HBM
+    sizing starts from.
+    """
+    import jax
+    import numpy as np
+
+    from hpbandster_tpu.obs.profile import roofline_report
+    from hpbandster_tpu.ops.bracket import mesh_aligned_plan
+    from hpbandster_tpu.ops.sweep import build_space_codec, make_fused_sweep_fn
+    from hpbandster_tpu.parallel.mesh import config_mesh, shard_count
+    from hpbandster_tpu.parallel.multihost import run_sharded_fused_sweep
+    from hpbandster_tpu.workloads.ensemble import (
+        MLPConfig, ensemble_lane_bytes, make_mlp_ensemble,
+    )
+    from hpbandster_tpu.workloads.mlp import mlp_space
+
+    cfg = MLPConfig(d_in=8, width=16, n_classes=4, n_train=128, n_val=64,
+                    batch_size=32)
+    se = make_mlp_ensemble(cfg, data_seed=seed)
+    space = mlp_space(seed=seed)
+    codec = build_space_codec(space)
+    n_dev = len(jax.devices())
+
+    # ---- unrolled arm: AOT compile -> cost analysis -> roofline row
+    plan = mesh_aligned_plan(n_configs, 1.0, float(max_budget), 3.0, 1)
+    assert plan.num_configs[0] >= 256, plan  # the ISSUE 17 rung-size bar
+    fn = make_fused_sweep_fn(
+        None, [plan] * n_brackets, codec, stateful_eval=se,
+        # HyperBand mode (unreachable KDE gate): the tier measures the
+        # training program, not proposal math
+        min_points_in_model=2**30, incumbent_only=True,
+        program_name="ensemble_sweep",
+    )
+    t0 = time.perf_counter()
+    compiled = fn.lower(np.uint32(seed)).compile()
+    compile_s = time.perf_counter() - t0
+    jax.device_get(compiled(np.uint32(seed)))  # warmup execution
+    times = []
+    for i in range(repeats):
+        t0 = time.perf_counter()
+        inc = jax.device_get(compiled(np.uint32(seed + i)))
+        times.append(time.perf_counter() - t0)
+    execute_s = statistics.median(times)
+    evals_per_sweep = n_brackets * sum(plan.num_configs)
+
+    # roofline follow-through (ISSUE 17 satellite): the AOT path recorded
+    # cost_analysis, so the report must carry a classified row for the
+    # ensemble program — intensity always; bound when a peak table entry
+    # exists, else the CPU no-peak caveat stands in
+    report = roofline_report(
+        seconds_by_program={"ensemble_sweep": execute_s}
+    )
+    rows = [r for r in report["programs"] if r["fn"] == "ensemble_sweep"]
+    if not rows:
+        raise AssertionError(
+            "roofline_report has no 'ensemble_sweep' row — the AOT "
+            "cost-analysis path regressed: %r"
+            % [r["fn"] for r in report["programs"]]
+        )
+    roof = rows[-1]
+    if not roof["flops"] or roof["intensity_flops_per_byte"] is None:
+        raise AssertionError(
+            "ensemble program not classified (flops=%r intensity=%r)"
+            % (roof["flops"], roof["intensity_flops_per_byte"])
+        )
+    if roof["bound"] is None and not report["caveats"]:
+        raise AssertionError(
+            "no bound classification AND no no-peak caveat — the "
+            "roofline contract lost its honesty clause"
+        )
+
+    # ---- resident arm: flat host-link bill with live model state
+    mesh = config_mesh()
+    n_shards = shard_count(mesh, "config")
+    per_size, bills = [], set()
+    for n in resident_sizes:
+        run_sharded_fused_sweep(  # warmup: compile this size's program
+            None, space, n_configs=n, min_budget=1, max_budget=max_budget,
+            eta=3, mesh=mesh, seed=seed + 99, n_brackets=n_brackets,
+            resident=True, device_metrics=True, stateful_eval=se,
+            program_name="ensemble_sweep",
+        )
+        r = run_sharded_fused_sweep(
+            None, space, n_configs=n, min_budget=1, max_budget=max_budget,
+            eta=3, mesh=mesh, seed=seed, n_brackets=n_brackets,
+            resident=True, device_metrics=True, stateful_eval=se,
+            program_name="ensemble_sweep",
+        )
+        bills.add((r["d2h_bytes"], r["h2d_bytes"], r["host_syncs"]))
+        per_size.append({
+            "n_configs": n,
+            "evaluations": r["evaluations"],
+            "execute_fetch_s": r["execute_fetch_s"],
+            "d2h_bytes": r["d2h_bytes"],
+            "h2d_bytes": r["h2d_bytes"],
+            "host_syncs": r["host_syncs"],
+            "incumbent_loss": r["incumbent"]["loss"],
+        })
+    if len(bills) != 1:
+        # the acceptance bar: live training state scaling the host link
+        # is a regression in the resident contract — say so loudly
+        raise AssertionError(
+            "ensemble resident host-link bill is NOT flat in config "
+            "count: %r" % sorted(bills)
+        )
+
+    lane_bytes = ensemble_lane_bytes(cfg)
+    return {
+        "model": "MLP %dx%dx%d, %d train samples, batch %d" % (
+            cfg.d_in, cfg.width, cfg.n_classes, cfg.n_train,
+            cfg.batch_size,
+        ),
+        "budget_semantics": "cumulative SGD steps, ladder 1..%d" % max_budget,
+        "configs_per_rung": plan.num_configs[0],
+        "unrolled": {
+            "compile_s": round(compile_s, 3),
+            "execute_s": round(execute_s, 4),
+            "evaluations": evals_per_sweep,
+            "configs_per_s_per_chip": round(
+                evals_per_sweep / execute_s / n_dev, 2
+            ) if execute_s else None,
+            "incumbent_loss": float(np.asarray(inc.loss)),
+        },
+        "roofline": {
+            "flops": roof["flops"],
+            "bytes_accessed": roof["bytes_accessed"],
+            "intensity_flops_per_byte": roof["intensity_flops_per_byte"],
+            "bound": roof["bound"],
+            "achieved_flops_per_s": roof.get("achieved_flops_per_s"),
+            "utilization_vs_peak": roof.get("utilization_vs_peak"),
+            "caveats": report["caveats"],
+        },
+        "resident": {
+            "per_size": per_size,
+            "d2h_flat": True,
+            "host_syncs_per_sweep": per_size[0]["host_syncs"],
+        },
+        # HBM sizing input (docs/workloads.md memory formula): state bytes
+        # per lane; a rung's ensemble costs n_configs x this, plus the
+        # shared dataset
+        "lane_state_bytes": lane_bytes,
+        "rung_state_mb": round(
+            plan.num_configs[0] * lane_bytes / 1e6, 3
         ),
     }
 
@@ -2257,6 +2430,12 @@ TIER_BUDGETS = {
     # down per sweep — the 8 MB ceiling is pure headroom for the warmup
     # runs' bills
     "resident_100k":   {"max_compiles": 10, "max_transfer_mb": 8},
+    # real-model ensemble tier: one AOT unrolled program + one resident
+    # program per config-count size (2 sizes) — the warmups reuse the
+    # process-wide executable caches, so 8 is structural ceiling + slack.
+    # Transfers stay incumbent-only (the live model state NEVER crosses
+    # the host link — that is the tier's flat-bill assertion)
+    "ensemble_smoke":  {"max_compiles": 8,  "max_transfer_mb": 8},
     "fused_1M":        {"max_compiles": 4,  "max_transfer_mb": 16},
     "chunked_compile": {"max_compiles": 8,  "max_transfer_mb": 16},
     "chunked10k":      {"max_compiles": 20, "max_transfer_mb": 128},
@@ -2476,6 +2655,11 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
             errors, "resident_100k", bench_resident_sharded,
             sizes=(1024, 4096), kde_fit_sizes=(1 << 12, 1 << 14),
             cpu_fallback=True))
+        # smoke rung of the real-model tier: same code path (vmapped SGD
+        # ensemble, warm continuation, roofline row, flat-bill assert)
+        ensemble_smoke = emit("ensemble_smoke", _run_tier(
+            errors, "ensemble_smoke", bench_ensemble_smoke,
+            repeats=repeats))
         fused_1M = {"skipped": "--smoke: the 1M-config program is not a "
                                "smoke-size measurement"}
         rpc_rates = _run_tier(errors, "rpc", bench_rpc_baseline,
@@ -2588,6 +2772,14 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
                 errors, "resident_100k", bench_resident_sharded,
                 cpu_fallback=bool(backend_error)))
             if selected("resident_100k") else dict(NOT_SELECTED)
+        )
+        # the real-model tier is CPU-sized BY DESIGN (small MLP, seconds
+        # on the fallback path): real training numbers stop being skipped
+        # on every CPU round — the r02-era "workloads skipped" gap
+        ensemble_smoke = (
+            emit("ensemble_smoke", _run_tier(
+                errors, "ensemble_smoke", bench_ensemble_smoke))
+            if selected("ensemble_smoke") else dict(NOT_SELECTED)
         )
         if not selected("fused10k"):
             fused10k = dict(NOT_SELECTED)
@@ -2823,6 +3015,7 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
             "fused_1M_mesh_sharded": fused_1M,
             "fused_100k_mesh_sharded": fused_100k,
             "resident_100k_scan_fused": resident_100k,
+            "ensemble_smoke_real_model": ensemble_smoke,
             "cnn_workload_budget_sgd_steps": cnn,
             "cnn_wide_mxu_saturation": cnn_wide,
             "resnet_workload_budget_sgd_steps": resnet,

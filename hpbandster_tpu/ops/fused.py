@@ -16,7 +16,7 @@ mesh-padding rows), index-stably — matching ``sh_promotion_mask``.
 
 from __future__ import annotations
 
-from typing import Callable, List, Sequence, Tuple
+from typing import Any, Callable, List, NamedTuple, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -24,8 +24,8 @@ import numpy as np
 
 from hpbandster_tpu.obs.runtime import note_transfer, tracked_jit
 
-__all__ = ["fused_sh_bracket", "make_fused_bracket_fn", "shard_rows",
-           "stage_telemetry"]
+__all__ = ["StatefulEval", "fused_sh_bracket", "make_fused_bracket_fn",
+           "shard_rows", "stage_telemetry"]
 
 #: crashed (NaN) losses map here for ranking: behind any real loss, ahead of
 #: the +inf padding rows, ties broken index-stably by top_k — the same
@@ -109,6 +109,49 @@ def stage_telemetry(
     return hist, jnp.sum(crashed).astype(jnp.int32)
 
 
+class StatefulEval(NamedTuple):
+    """Stateful-evaluation seam beside ``eval_fn``: real-model training
+    whose live state (weight/optimizer pytrees) threads through the rung
+    ladder so promoted configs CONTINUE training instead of restarting.
+
+    ``init_fn(vectors f32[n, d]) -> state`` builds the rung's ensemble:
+    a pytree whose every leaf carries a leading config axis of size ``n``
+    (one lane per config row, padding rows included).
+
+    ``step_fn(state, vectors f32[k, d], budget, prev_budget) ->
+    (state, losses f32[k])`` advances each lane from cumulative budget
+    ``prev_budget`` to ``budget`` (both CONCRETE floats — static trip
+    counts for the inner ``lax.scan``) and returns the lanes' current
+    validation losses. Lane ``i`` of the state corresponds to row ``i``
+    of ``vectors``; a crashed (diverged) lane reports NaN and must not
+    influence any other lane — the bracket ranks it with the shared
+    crash key, exactly like the stateless path.
+
+    The bracket gathers surviving state leaves with the SAME top-k
+    indices the rung ranked by (``jax.tree.map(lambda l: l[top], state)``),
+    so promotion selects among live training states — warm continuation.
+    Evicted lanes simply drop out of the gather; the next bracket's
+    ``init_fn`` re-creates fresh lanes in-trace. See
+    ``workloads/ensemble.py`` for the vmapped-SGD reference
+    implementation and ``docs/workloads.md`` for the protocol contract.
+    """
+
+    init_fn: Callable[[jax.Array], Any]
+    step_fn: Callable[[Any, jax.Array, float, float], Tuple[Any, jax.Array]]
+
+
+def _shard_state(state, mesh, axis: str):
+    """Naive per-leaf sharding of an ensemble state: every leaf's leading
+    config axis stays distributed over ``axis`` (the SNIPPETS
+    ``shard_params`` path — shard when divisible, else leave XLA free).
+    A 2-D model x config layout via ``match_partition_rules``-style regex
+    trees is deliberately NOT wired here yet (reserved for a real
+    model-parallel mesh); one axis is the honest current scope."""
+    if mesh is None:
+        return state
+    return jax.tree.map(lambda leaf: shard_rows(leaf, mesh, axis), state)
+
+
 def fused_sh_bracket(
     eval_fn: Callable[[jax.Array, float], jax.Array],
     vectors: jax.Array,
@@ -117,6 +160,8 @@ def fused_sh_bracket(
     rank_fn: Callable[[jax.Array, jax.Array, float], jax.Array] = None,
     mesh=None,
     axis: str = "config",
+    stateful: "StatefulEval" = None,
+    return_final_state: bool = False,
 ) -> List[Tuple[jax.Array, jax.Array]]:
     """Trace one whole bracket. Returns per-stage ``(indices, losses)``
     where ``indices`` index the original (unpadded) stage-0 rows.
@@ -136,7 +181,25 @@ def fused_sh_bracket(
     constraint never changes values; a 1-device mesh is the unsharded
     program), but the rung reduction and survivor gather lower to ICI
     collectives instead of a single-device round-trip.
+
+    ``stateful`` (a :class:`StatefulEval`, exclusive with ``eval_fn``)
+    switches every stage to the warm-continuation protocol: stage 0 runs
+    ``init_fn`` then ``step_fn(state, vecs, budgets[0], 0.0)``; stage ``s``
+    gathers the surviving state leaves by the promotion's ``top`` indices
+    and runs ``step_fn(state, vecs, budgets[s], budgets[s-1])`` — each lane
+    trains only the INCREMENTAL budget, carrying its weights across rungs.
+    State leaves keep the per-stage sharding constraints the loss batches
+    get. ``return_final_state=True`` additionally returns the last stage's
+    surviving state (``(stages, state)``) for callers that extract trained
+    weights — the fused sweep itself leaves it device-internal.
     """
+    if (eval_fn is None) == (stateful is None):
+        raise ValueError(
+            "provide exactly one evaluation seam: eval_fn (stateless) or "
+            "stateful (StatefulEval warm continuation)"
+        )
+    if return_final_state and stateful is None:
+        raise ValueError("return_final_state=True requires stateful")
     n0 = int(num_configs[0])
     n_rows = vectors.shape[0]
     if n_rows < n0:
@@ -169,7 +232,17 @@ def fused_sh_bracket(
         return scores
 
     vectors = shard_rows(vectors, mesh, axis)
-    losses0 = eval_stage(vectors, float(budgets[0]))
+    state = None
+    if stateful is not None:
+        # one lane per row (padding rows train too — they can never be
+        # promoted, so their lanes are dead weight the mesh alignment pays)
+        state = _shard_state(stateful.init_fn(vectors), mesh, axis)
+        state, losses0 = stateful.step_fn(
+            state, vectors, float(budgets[0]), 0.0
+        )
+        losses0 = losses0.astype(jnp.float32)
+    else:
+        losses0 = eval_stage(vectors, float(budgets[0]))
     cur_idx = jnp.arange(n_rows, dtype=jnp.int32)
     history = [losses0]  # per-stage losses of the CURRENT survivor set
     cur_key = rank_key(scores_for(history, 0), cur_idx >= n0)
@@ -181,13 +254,28 @@ def fused_sh_bracket(
         top = jnp.sort(top)  # preserve original ordering among survivors
         sel_idx = cur_idx[top]
         sel_vecs = shard_rows(vectors[sel_idx], mesh, axis)
-        losses_s = eval_stage(sel_vecs, float(budgets[s]))
+        if stateful is not None:
+            # warm continuation: gather the SURVIVING lanes' live state by
+            # the same local top-k indices the rank just promoted, then
+            # train only the incremental budget from where they left off —
+            # evicted lanes simply drop out of the gather
+            state = _shard_state(
+                jax.tree.map(lambda leaf: leaf[top], state), mesh, axis
+            )
+            state, losses_s = stateful.step_fn(
+                state, sel_vecs, float(budgets[s]), float(budgets[s - 1])
+            )
+            losses_s = losses_s.astype(jnp.float32)
+        else:
+            losses_s = eval_stage(sel_vecs, float(budgets[s]))
         cur_idx = sel_idx
         history = [col[top] for col in history] + [losses_s]
         cur_key = rank_key(
             scores_for(history, s), jnp.zeros_like(sel_idx, dtype=bool)
         )
         out.append((cur_idx, losses_s))
+    if return_final_state:
+        return out, state
     return out
 
 

@@ -95,12 +95,20 @@ class FusedBOHB:
         logger: Optional[logging.Logger] = None,
         previous_result: Optional[Result] = None,
         use_pallas: Optional[bool] = None,
+        stateful_eval=None,
     ):
         if configspace is None:
             raise ValueError("you have to provide a valid ConfigurationSpace object")
-        if eval_fn is None:
+        if eval_fn is None and stateful_eval is None:
             raise ValueError(
-                "FusedBOHB needs a jittable eval_fn(config_vector, budget) -> loss"
+                "FusedBOHB needs a jittable eval_fn(config_vector, budget) "
+                "-> loss, or a StatefulEval (warm-continuation ensemble "
+                "training, ops.fused.StatefulEval)"
+            )
+        if eval_fn is not None and stateful_eval is not None:
+            raise ValueError(
+                "eval_fn and stateful_eval are exclusive: one evaluation "
+                "seam per optimizer"
             )
         self.configspace = configspace
         self.codec = build_space_codec(configspace)
@@ -151,32 +159,57 @@ class FusedBOHB:
         import jax.numpy as _jnp
 
         d = int(self.codec.kind.shape[0])
-        try:
-            out_sds = _jax.eval_shape(
-                lambda v: eval_fn(v, float(min_budget)),
-                _jax.ShapeDtypeStruct((d,), _jnp.float32),
-            )
-        except Exception as e:
-            # deliberately broad: eval_shape surfaces plain bugs inside
-            # eval_fn (wrong arity, NameError) as well as tracing errors,
-            # so the banner says what was ATTEMPTED, not what went wrong —
-            # the chained original exception carries the real diagnosis
-            # (ADVICE r4)
-            raise ValueError(
-                f"eval_fn(config_vector f32[{d}], budget) failed under "
-                f"abstract evaluation (jax.eval_shape) for this {d}-dim "
-                f"space: {type(e).__name__}: {e}"
-            ) from e
-        leaves = _jax.tree_util.tree_leaves(out_sds)
-        shapes = [tuple(getattr(l, "shape", ())) for l in leaves]
-        if len(leaves) != 1 or shapes[0] != ():
-            raise ValueError(
-                "eval_fn must return a single SCALAR loss, got "
-                f"{len(leaves)} output leaves with shapes {shapes} — "
-                "reduce per-example losses (e.g. .mean()) and drop aux "
-                "outputs before returning"
-            )
+        if stateful_eval is not None:
+            # same fail-fast contract for the stateful seam: a 2-lane
+            # abstract init->step round-trip surfaces protocol bugs
+            # (wrong arity, non-batched losses) before the sweep trace
+            # buries them in an opaque XLA error
+            try:
+                _, losses_sds = _jax.eval_shape(
+                    lambda v: stateful_eval.step_fn(
+                        stateful_eval.init_fn(v), v, float(min_budget), 0.0
+                    ),
+                    _jax.ShapeDtypeStruct((2, d), _jnp.float32),
+                )
+            except Exception as e:
+                raise ValueError(
+                    f"stateful_eval failed under abstract evaluation "
+                    f"(init_fn + step_fn over f32[2, {d}] vectors): "
+                    f"{type(e).__name__}: {e}"
+                ) from e
+            if tuple(getattr(losses_sds, "shape", ())) != (2,):
+                raise ValueError(
+                    "stateful_eval.step_fn must return per-lane losses "
+                    f"f32[n], got shape {getattr(losses_sds, 'shape', None)}"
+                )
+        else:
+            try:
+                out_sds = _jax.eval_shape(
+                    lambda v: eval_fn(v, float(min_budget)),
+                    _jax.ShapeDtypeStruct((d,), _jnp.float32),
+                )
+            except Exception as e:
+                # deliberately broad: eval_shape surfaces plain bugs inside
+                # eval_fn (wrong arity, NameError) as well as tracing errors,
+                # so the banner says what was ATTEMPTED, not what went wrong —
+                # the chained original exception carries the real diagnosis
+                # (ADVICE r4)
+                raise ValueError(
+                    f"eval_fn(config_vector f32[{d}], budget) failed under "
+                    f"abstract evaluation (jax.eval_shape) for this {d}-dim "
+                    f"space: {type(e).__name__}: {e}"
+                ) from e
+            leaves = _jax.tree_util.tree_leaves(out_sds)
+            shapes = [tuple(getattr(l, "shape", ())) for l in leaves]
+            if len(leaves) != 1 or shapes[0] != ():
+                raise ValueError(
+                    "eval_fn must return a single SCALAR loss, got "
+                    f"{len(leaves)} output leaves with shapes {shapes} — "
+                    "reduce per-example losses (e.g. .mean()) and drop aux "
+                    "outputs before returning"
+                )
         self.eval_fn = eval_fn
+        self.stateful_eval = stateful_eval
         self.run_id = run_id
         self.eta = float(eta)
         self.min_budget = float(min_budget)
@@ -310,7 +343,9 @@ class FusedBOHB:
             warm_counts = {b: len(l) for b, l in self._warm_l.items()}
             obs_term = tuple(sorted(warm_counts.items()))
         return (
-            self.eval_fn,
+            # exactly one of these is non-None (ctor contract), so the
+            # pair keys stateless and stateful executables apart
+            (self.eval_fn, self.stateful_eval),
             tuple((p.num_configs, p.budgets) for p in plans),
             self.codec.signature,
             self.num_samples,
@@ -359,11 +394,13 @@ class FusedBOHB:
             capacities=caps,
             # the dynamic tier returns (and the warm inputs donate into)
             # the updated observation state, so consecutive chunks thread
-            # it device-to-device instead of re-uploading warm buffers
+            # it device-to-device across chunk boundaries — the ensemble
+            # state itself is bracket-local scratch and never part of it
             return_state=dynamic and not incumbent_only,
             resident=resident,
             incumbent_only=incumbent_only,
             device_metrics=device_metrics,
+            stateful_eval=self.stateful_eval,
         )
 
     def _sweep_compiled(self, plans, example_args, dynamic=False, caps=None,

@@ -190,6 +190,8 @@ def run_sharded_fused_sweep(
     publish_gauges: bool = True,
     resident: bool = False,
     device_metrics: Optional[bool] = None,
+    stateful_eval=None,
+    program_name: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Mesh-sharded fused successive halving at 100k-1M config scale.
 
@@ -226,6 +228,14 @@ def run_sharded_fused_sweep(
     enabled (the ``resident_100k`` bench tier measures exactly that).
     The decoded record is published as gauges, journaled as
     ``device_telemetry``, and returned under ``"device_telemetry"``.
+
+    ``stateful_eval`` (exclusive with ``eval_fn``, pass ``eval_fn=None``)
+    runs the sweep over a warm-continuation ensemble
+    (``ops.fused.StatefulEval`` — e.g. ``workloads.ensemble``): every
+    rung trains live models in-trace and promotions carry their weights.
+    The ensemble state is bracket-local device scratch, so the flat
+    host-link bill above is untouched. ``program_name`` labels the
+    compiled program in the obs ledger (roofline attribution).
 
     Returns a stats dict (incumbent, per-device balance, chunk timings).
     SPMD multi-host: call on every rank with identical arguments over a
@@ -349,7 +359,9 @@ def run_sharded_fused_sweep(
             from hpbandster_tpu.ops.kde import _pallas_fit_requested
 
             cache_key = (
-                eval_fn,
+                # exactly one is non-None; the pair keys stateless and
+                # stateful (warm-continuation) executables apart
+                (eval_fn, stateful_eval),
                 tuple((p.num_configs, p.budgets) for p in chunk_plans),
                 codec_sig, mesh, axis, bool(model), int(num_samples),
                 dynamic, bool(resident),
@@ -361,6 +373,10 @@ def run_sharded_fused_sweep(
                 # metrics-on executable must never serve a metrics-off
                 # call (or vice versa)
                 use_dm,
+                # the ledger label is part of what the caller asked for:
+                # a relabeled request must not serve a fn tracked under
+                # the old name (roofline attribution would lie)
+                program_name,
             )
             cached = _SHARDED_FN_CACHE.get(cache_key)
             if cached is None:
@@ -373,6 +389,8 @@ def run_sharded_fused_sweep(
                     return_state=dynamic and not resident,
                     resident=resident,
                     device_metrics=use_dm,
+                    stateful_eval=stateful_eval,
+                    program_name=program_name,
                     **sweep_kwargs,
                 )
                 _SHARDED_FN_CACHE[cache_key] = cached

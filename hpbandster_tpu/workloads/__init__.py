@@ -29,6 +29,13 @@ from hpbandster_tpu.workloads.resnet import (  # noqa: F401
     resnet_forward,
     resnet_space,
 )
+from hpbandster_tpu.workloads.ensemble import (  # noqa: F401
+    EnsembleState,
+    ensemble_lane_bytes,
+    make_mlp_ensemble,
+    make_uninterrupted_train_fn,
+    shard_ensemble_state,
+)
 from hpbandster_tpu.workloads.mlp import (  # noqa: F401
     MLPConfig,
     batched_sgd_train_step,

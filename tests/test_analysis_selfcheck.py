@@ -71,6 +71,23 @@ def test_serve_tree_is_scanned_and_clean():
     assert findings == [], "\n" + format_report(findings)
 
 
+def test_workloads_tree_is_scanned_and_clean():
+    """ISSUE 17 coverage extension: the workloads tree (now carrying the
+    vmapped-SGD ensemble and its jit sites) is inside the gate's walk and
+    clean under the full rule pack — including jit-donation, which
+    requires every new ``tracked_jit`` site to take an explicit
+    ``donate_argnums`` stance."""
+    from hpbandster_tpu.analysis import collect_files
+
+    workloads_tree = REPO / "hpbandster_tpu" / "workloads"
+    scanned = set(collect_files(SCAN))
+    workloads_files = {str(p) for p in workloads_tree.glob("*.py")}
+    assert str(workloads_tree / "ensemble.py") in workloads_files
+    assert workloads_files <= scanned, sorted(workloads_files - scanned)
+    findings = run([str(workloads_tree)])
+    assert findings == [], "\n" + format_report(findings)
+
+
 def test_cli_exits_zero_on_clean_tree(capsys):
     assert main(SCAN) == 0
     assert "clean" in capsys.readouterr().out

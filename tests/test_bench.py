@@ -313,6 +313,51 @@ class TestResidentTier:
             bench.BUDGET_VERDICTS.pop("resident_100k", None)
 
 
+class TestEnsembleTier:
+    """ISSUE 17 acceptance: the ``ensemble_smoke`` tier trains REAL MLP
+    ensembles (>= 256 configs per rung) end to end under both sweep
+    modes, budget-gated; the roofline row classifies the training
+    program, and the resident host-link bill stays flat with live model
+    state in the carry. Small sizes/repeats keep the CPU wall low — the
+    assertions inside the tier are size-independent."""
+
+    @pytest.mark.slow
+    def test_ensemble_tier_runs_budget_gated(self):
+        import jax
+
+        assert len(jax.devices()) == 8  # the conftest-forced CPU mesh
+        errors = {}
+        out = bench._run_tier(
+            errors, "ensemble_smoke", bench.bench_ensemble_smoke,
+            repeats=1, resident_sizes=(256, 512),
+        )
+        try:
+            assert errors == {}, errors
+            assert out is not None
+            # the ISSUE 17 rung-size bar, in the artifact itself
+            assert out["configs_per_rung"] >= 256
+            assert out["unrolled"]["evaluations"] > 0
+            # roofline classified the training program: intensity always;
+            # bound OR the no-peak caveat (the honesty clause)
+            roof = out["roofline"]
+            assert roof["flops"] and roof["intensity_flops_per_byte"]
+            assert roof["bound"] is not None or roof["caveats"]
+            # flat host-link bill with live ensemble state (the tier
+            # raises if not, but pin the artifact fields too)
+            res = out["resident"]
+            assert res["d2h_flat"] is True
+            assert [r["n_configs"] for r in res["per_size"]] == [256, 512]
+            assert res["per_size"][0]["h2d_bytes"] == 4  # one uint32 seed
+            # memory-formula fields the docs point at
+            assert out["lane_state_bytes"] > 0
+            assert out["rung_state_mb"] > 0
+            v = bench.BUDGET_VERDICTS["ensemble_smoke"]
+            assert v["ok"], v
+        finally:
+            bench.COMPILE_BY_TIER.pop("ensemble_smoke", None)
+            bench.BUDGET_VERDICTS.pop("ensemble_smoke", None)
+
+
 class TestServeContinuousTier:
     """ISSUE 15 acceptance: the ``serve_continuous`` tier runs END TO END
     (small lane count, 8-device CPU mesh conftest), budget-gated, with
@@ -811,7 +856,8 @@ class TestTierSelection:
         # the --tiers vocabulary and the execution order are one constant
         assert set(bench.TIER_ORDER) == {
             "cnn", "cnn_wide", "pallas", "resnet", "transformer",
-            "fused_1M", "fused_100k", "resident_100k", "fused10k",
+            "fused_1M", "fused_100k", "resident_100k", "ensemble_smoke",
+            "fused10k",
             "chunked10k", "chunked_compile", "fused", "rpc", "batched",
             "teacher", "multitenant", "serve_continuous", "chaos",
             "async_straggler", "obs_overhead", "runtime_overhead",

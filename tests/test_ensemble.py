@@ -1,0 +1,327 @@
+"""Vmapped-SGD ensemble tests (ISSUE 17).
+
+The tentpole contracts, pinned bitwise where the tree pins everything
+bitwise:
+
+- **warm continuation**: a config promoted through the fused rung ladder
+  exits with EXACTLY the weights an uninterrupted train of the same
+  cumulative step count produces — the staged segments + survivor
+  gathers are bit-invisible.
+- **crash containment**: a diverged (NaN) lane ranks behind every real
+  loss and its poisoned state never touches a surviving lane.
+- **resident/unrolled parity**: the ensemble sweep is bit-identical
+  between the unrolled dynamic tier and the scan-fused resident tier on
+  the conftest 8-device CPU mesh.
+- **scale acceptance**: one dispatch trains >= 256 configs per rung
+  under both ``make_fused_sweep_fn`` and ``resident=True``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hpbandster_tpu.ops.bracket import BracketPlan, mesh_aligned_plan
+from hpbandster_tpu.ops.fused import StatefulEval, fused_sh_bracket
+from hpbandster_tpu.ops.sweep import (
+    build_space_codec,
+    make_fused_sweep_fn,
+    plan_additions,
+    pow2_capacities,
+)
+from hpbandster_tpu.parallel.mesh import config_mesh
+from hpbandster_tpu.workloads.ensemble import (
+    EnsembleState,
+    ensemble_lane_bytes,
+    make_mlp_ensemble,
+    make_uninterrupted_train_fn,
+    shard_ensemble_state,
+)
+from hpbandster_tpu.workloads.mlp import MLPConfig, mlp_space
+
+#: CPU-sized model: every test here trains REAL ensembles, so the model
+#: must be seconds-cheap at hundreds of lanes
+CFG = MLPConfig(d_in=8, width=16, n_classes=4, n_train=128, n_val=64,
+                batch_size=32)
+
+
+def _vectors(n, d=4, seed=0):
+    return jax.random.uniform(jax.random.key(seed), (n, d))
+
+
+def _assert_trees_bitwise(a, b, msg=""):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert np.array_equal(
+            np.asarray(x), np.asarray(y), equal_nan=True
+        ), msg or "state leaves diverged"
+
+
+class TestWarmContinuation:
+    """The acceptance bar: promoted configs continue from live weights,
+    bit-identically to never having been staged at all."""
+
+    def test_promoted_weights_bitwise_match_uninterrupted(self):
+        se = make_mlp_ensemble(CFG, data_seed=0)
+        ref = make_uninterrupted_train_fn(CFG, data_seed=0)
+        vectors = _vectors(27, seed=7)
+        num_configs, budgets = (27, 9, 3), (3.0, 9.0, 27.0)
+
+        @jax.jit
+        def run(v):
+            return fused_sh_bracket(
+                None, v, num_configs, budgets, stateful=se,
+                return_final_state=True,
+            )
+
+        stages, state = run(vectors)
+        idx_f, loss_f = np.asarray(stages[-1][0]), np.asarray(stages[-1][1])
+        # uninterrupted: same survivors trained 27 cumulative steps in ONE
+        # segment — weights AND losses must match the staged path bitwise
+        ref_state, ref_loss = ref(vectors[idx_f], 27)
+        assert np.array_equal(np.asarray(ref_loss), loss_f)
+        _assert_trees_bitwise(
+            state, ref_state,
+            "warm continuation is not bit-invisible: staged weights "
+            "diverged from the uninterrupted train",
+        )
+
+    def test_intermediate_rungs_match_uninterrupted_losses(self):
+        """Every rung's reported losses — not just the final one — are the
+        uninterrupted-training losses at that cumulative step count."""
+        se = make_mlp_ensemble(CFG, data_seed=1)
+        ref = make_uninterrupted_train_fn(CFG, data_seed=1)
+        vectors = _vectors(8, seed=3)
+        num_configs, budgets = (8, 4, 2), (2.0, 5.0, 11.0)
+
+        @jax.jit
+        def run(v):
+            return fused_sh_bracket(None, v, num_configs, budgets,
+                                    stateful=se)
+
+        stages = run(vectors)
+        for (idx_s, loss_s), b in zip(stages, budgets):
+            _, ref_loss = ref(vectors[np.asarray(idx_s)], int(b))
+            assert np.array_equal(
+                np.asarray(ref_loss), np.asarray(loss_s)
+            ), f"rung at budget {b} diverged from uninterrupted training"
+
+    def test_budget_must_round_to_nondecreasing_steps(self):
+        se = make_mlp_ensemble(CFG, data_seed=0)
+        state = se.init_fn(_vectors(2))
+        with pytest.raises(ValueError, match="non-decreasing"):
+            se.step_fn(state, _vectors(2), 1.0, 5.0)
+
+
+class TestCrashContainment:
+    """A diverged model never pollutes a surviving lane's state, and its
+    NaN loss ranks behind every real loss in the promotion."""
+
+    def test_poisoned_lane_leaves_other_lanes_bitwise_unchanged(self):
+        se = make_mlp_ensemble(CFG, data_seed=0)
+        vectors = _vectors(4, seed=11)
+        clean = se.init_fn(vectors)
+        poisoned = jax.tree.map(
+            lambda leaf: leaf.at[1].set(jnp.nan), clean
+        )
+        step = jax.jit(lambda st, v: se.step_fn(st, v, 5.0, 0.0))
+        clean_state, clean_loss = step(clean, vectors)
+        pois_state, pois_loss = step(poisoned, vectors)
+        # the poisoned lane crashed...
+        assert np.isnan(np.asarray(pois_loss)[1])
+        for leaf in jax.tree.leaves(pois_state):
+            assert np.all(np.isnan(np.asarray(leaf)[1]))
+        # ...and every OTHER lane is bitwise the clean run
+        keep = np.array([0, 2, 3])
+        assert np.array_equal(
+            np.asarray(clean_loss)[keep], np.asarray(pois_loss)[keep]
+        )
+        _assert_trees_bitwise(
+            jax.tree.map(lambda l: l[keep], clean_state),
+            jax.tree.map(lambda l: l[keep], pois_state),
+            "a crashed lane polluted a survivor's state",
+        )
+
+    def test_crashed_lane_ranks_last_and_never_promotes(self):
+        """Bracket-level containment: a lane whose step reports NaN is
+        never gathered into the next rung, so the carried ensemble state
+        stays NaN-free through the whole ladder."""
+        se = make_mlp_ensemble(CFG, data_seed=0)
+        # crash predicate rides the config vector (stable across survivor
+        # gathers): dimension 3 pinned to 1.0 marks the doomed lane
+        def crash_step(state, vectors, budget, prev_budget):
+            state, losses = se.step_fn(state, vectors, budget, prev_budget)
+            crashed = vectors[:, 3] >= 0.999
+            losses = jnp.where(crashed, jnp.nan, losses)
+            state = jax.tree.map(
+                lambda leaf: jnp.where(
+                    crashed.reshape((-1,) + (1,) * (leaf.ndim - 1)),
+                    jnp.nan, leaf,
+                ),
+                state,
+            )
+            return state, losses
+
+        crash_se = StatefulEval(se.init_fn, crash_step)
+        doomed = 2
+        vectors = (0.9 * _vectors(8, seed=5)).at[doomed, 3].set(1.0)
+
+        @jax.jit
+        def run(v):
+            return fused_sh_bracket(
+                None, v, (8, 4, 2), (1.0, 3.0, 9.0), stateful=crash_se,
+                return_final_state=True,
+            )
+
+        stages, state = run(vectors)
+        assert np.isnan(np.asarray(stages[0][1])[doomed])
+        for idx_s, _ in stages[1:]:
+            assert doomed not in np.asarray(idx_s)
+        for leaf in jax.tree.leaves(state):
+            assert np.all(np.isfinite(np.asarray(leaf))), (
+                "NaN state leaked through a survivor gather"
+            )
+
+
+class TestResidentParity:
+    """Resident (scan-fused) vs unrolled dynamic ensemble sweep on the
+    conftest 8-device CPU mesh: bit-identical incumbents."""
+
+    def _build(self, resident, plans, caps, codec, se, mesh):
+        return make_fused_sweep_fn(
+            None, plans, codec, stateful_eval=se,
+            min_points_in_model=2**30, dynamic_counts=True,
+            capacities=caps, incumbent_only=True, resident=resident,
+            mesh=mesh, shard_sampling=True,
+        )
+
+    def test_resident_matches_unrolled_bitwise_on_mesh(self):
+        assert len(jax.devices()) == 8  # the conftest-forced CPU mesh
+        mesh = config_mesh()
+        se = make_mlp_ensemble(CFG, data_seed=0)
+        codec = build_space_codec(mlp_space(0))
+        plan = mesh_aligned_plan(64, 1.0, 9.0, 3.0, 8)
+        plans = [plan, plan]
+        caps = pow2_capacities(plan_additions(plans))
+        d = int(codec.kind.shape[0])
+        wv = {b: np.zeros((c, d), np.float32) for b, c in caps.items()}
+        wl = {b: np.full(c, np.inf, np.float32) for b, c in caps.items()}
+        wn = {b: np.int32(0) for b in caps}
+
+        unrolled = self._build(False, plans, caps, codec, se, mesh)
+        resident = self._build(True, plans, caps, codec, se, mesh)
+        inc_u = jax.device_get(unrolled(np.uint32(13), wv, wl, wn))
+        inc_r = jax.device_get(resident(np.uint32(13), wv, wl, wn))
+        for name, lu, lr in zip(inc_u._fields, inc_u, inc_r):
+            assert np.array_equal(
+                np.asarray(lu), np.asarray(lr), equal_nan=True
+            ), f"incumbent leaf {name} diverged resident vs unrolled"
+
+
+class TestScaleAcceptance:
+    """ISSUE 17: one dispatch trains >= 256 MLP configs per rung under
+    both sweep modes (slow lane: two compiles of a 256-lane program)."""
+
+    @pytest.mark.slow
+    def test_256_configs_per_rung_both_modes(self):
+        se = make_mlp_ensemble(CFG, data_seed=0)
+        codec = build_space_codec(mlp_space(0))
+        plan = mesh_aligned_plan(256, 1.0, 9.0, 3.0, 1)
+        assert plan.num_configs[0] >= 256
+
+        fn = make_fused_sweep_fn(
+            None, [plan], codec, stateful_eval=se,
+            min_points_in_model=2**30, incumbent_only=True,
+        )
+        inc = jax.device_get(fn(np.uint32(3)))
+        assert np.isfinite(inc.loss)
+
+        caps = pow2_capacities(plan_additions([plan]))
+        fnr = make_fused_sweep_fn(
+            None, [plan], codec, stateful_eval=se,
+            min_points_in_model=2**30, dynamic_counts=True,
+            capacities=caps, incumbent_only=True, resident=True,
+        )
+        inc_r = jax.device_get(fnr(np.uint32(3)))
+        assert np.isfinite(inc_r.loss)
+
+
+class TestProtocolSeams:
+    """Constructor/validation contracts for the StatefulEval seam."""
+
+    def test_exactly_one_seam_required(self):
+        codec = build_space_codec(mlp_space(0))
+        plan = BracketPlan((4, 2), (1.0, 3.0))
+        with pytest.raises(ValueError, match="exactly one evaluation seam"):
+            make_fused_sweep_fn(None, [plan], codec)
+        se = make_mlp_ensemble(CFG, 0)
+        with pytest.raises(ValueError, match="exactly one evaluation seam"):
+            make_fused_sweep_fn(
+                lambda v, b: v.sum(), [plan], codec, stateful_eval=se
+            )
+        with pytest.raises(ValueError, match="exactly one evaluation seam"):
+            fused_sh_bracket(None, _vectors(4), (4, 2), (1.0, 3.0))
+
+    def test_return_final_state_requires_stateful(self):
+        with pytest.raises(ValueError, match="requires stateful"):
+            fused_sh_bracket(
+                lambda v, b: v.sum(), _vectors(4), (4, 2), (1.0, 3.0),
+                return_final_state=True,
+            )
+
+    def test_fused_bohb_validates_stateful_protocol(self):
+        from hpbandster_tpu.optimizers.fused_bohb import FusedBOHB
+
+        bad = StatefulEval(
+            init_fn=lambda v: {"p": jnp.zeros(3)},
+            step_fn=lambda s, v, b, pb: (s, jnp.float32(0.0)),  # scalar!
+        )
+        with pytest.raises(ValueError, match="per-lane losses"):
+            FusedBOHB(configspace=mlp_space(0), stateful_eval=bad,
+                      min_budget=1, max_budget=9)
+
+    def test_fused_bohb_seams_are_exclusive(self):
+        from hpbandster_tpu.optimizers.fused_bohb import FusedBOHB
+
+        se = make_mlp_ensemble(CFG, 0)
+        with pytest.raises(ValueError, match="exclusive"):
+            FusedBOHB(configspace=mlp_space(0), eval_fn=lambda v, b: v.sum(),
+                      stateful_eval=se, min_budget=1, max_budget=9)
+
+    def test_fused_bohb_runs_ensemble_end_to_end(self):
+        from hpbandster_tpu.optimizers.fused_bohb import FusedBOHB
+
+        se = make_mlp_ensemble(CFG, 0)
+        opt = FusedBOHB(configspace=mlp_space(3), stateful_eval=se,
+                        min_budget=1, max_budget=9, seed=5)
+        res = opt.run(n_iterations=2)
+        inc_id = res.get_incumbent_id()
+        assert inc_id is not None
+        runs = res.get_runs_by_id(inc_id)
+        assert np.isfinite(runs[-1].loss)
+
+
+class TestStateHelpers:
+    def test_lane_bytes_matches_actual_state(self):
+        se = make_mlp_ensemble(CFG, 0)
+        state = se.init_fn(_vectors(1))
+        actual = sum(
+            np.asarray(leaf).nbytes for leaf in jax.tree.leaves(state)
+        )
+        assert actual == ensemble_lane_bytes(CFG)
+
+    def test_shard_state_is_identity_on_values(self):
+        se = make_mlp_ensemble(CFG, 0)
+        state = se.init_fn(_vectors(8))
+        mesh = config_mesh()
+        sharded = jax.jit(
+            lambda s: shard_ensemble_state(s, mesh)
+        )(state)
+        _assert_trees_bitwise(
+            state, sharded, "a sharding constraint changed bits"
+        )
+        # no mesh: structural no-op too
+        same = shard_ensemble_state(state, None)
+        assert isinstance(same, EnsembleState)
+        _assert_trees_bitwise(state, same)

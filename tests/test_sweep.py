@@ -778,9 +778,14 @@ class TestFusedSweep:
             return a * budget ** (-k)
 
         cs = branin_space(seed=0)
+        # seed choice matters: the assertion needs the random stage-0 draw
+        # to contain at least one actual curve crossing inside the top-k
+        # boundary. Seed 30's draw happens to promote identically under
+        # both rankers (extrapolation reorders only within the survivor
+        # set); seed 0 has a boundary crossing.
         kwargs = dict(
             configspace=cs, eval_fn=crossing,
-            min_budget=1, max_budget=81, eta=3, seed=30,
+            min_budget=1, max_budget=81, eta=3, seed=0,
         )
         res_h2 = FusedH2BO(run_id="h2", **kwargs).run(n_iterations=1)
         res_sh = FusedBOHB(run_id="sh", **kwargs).run(n_iterations=1)
