@@ -69,7 +69,7 @@ TIER_ORDER = (
     "chunked10k",
     "chunked_compile", "fused",
     "rpc", "batched", "teacher", "multitenant", "serve_continuous",
-    "chaos", "async_straggler", "obs_overhead",
+    "chaos", "async_straggler", "obs_overhead", "timeline_overhead",
     "runtime_overhead", "collector_overhead", "report_100k",
 )
 
@@ -1431,6 +1431,195 @@ def bench_obs_overhead(repeats=3, n_iterations=3, inner=20, seed=0):
     }
 
 
+def bench_timeline_overhead(repeats=3, inner=8, seed=0, n_micro=100_000,
+                            sizes=(512, 4096)):
+    """Flight-recorder cost (obs/timeline.py) under the same <2% bar as
+    obs_overhead, plus the timeline tier's two structural assertions.
+
+    Headline (``overhead_pct``) is the RECORDER-OFF path, COMPUTED not
+    raced (the obs_overhead method): per-call cost of the inactive
+    timeline span API (no sink -> no clock reads, no Event) x the exact
+    record census of one warm fused sweep (device metrics on) / the warm
+    sweep wall — the cost every run pays now that the span API exists,
+    gated < 2% (the byte-identical-off guarantee). The recorder-ON
+    session cost rides along under ``recording``: per-record cost of an
+    attached TimelineRecorder (~one list append on top of the Event
+    construction EVERY sink pays) x the same census / the same wall.
+    That share is a worst case by construction — the census sweep's
+    objective is ~one FLOP per eval, so the wall is pure dispatch; on
+    any real workload the µs-scale per-record cost vanishes (same
+    framing as obs_overhead's ``toy_share_pct``). An interleaved A/B
+    wall cross-check rides along (same caveat as obs_overhead:
+    shared-host noise floor >> sub-percent effects).
+
+    Structural assertions:
+
+    * flat host link — the ``rung_seq`` stamp rides the O(schedule)
+      telemetry pytree, so the device-metrics payload bytes must be
+      IDENTICAL across config counts (``sizes``); growth means the stamp
+      leaked an O(configs) term onto the resident d2h bill (hard error).
+    * critical path — the analyzer runs over the recorded sweep journal;
+      its machine-readable verdict lands in BUDGET_VERDICTS (persisted as
+      detail.budgets.verdicts.timeline_critical_path, next to the
+      compile/transfer verdicts). Recorded, not gated: a toy sweep's
+      ms-scale wall makes the share noisy, and the e2e test pins the
+      >=95% claim on a controlled journal.
+    """
+    import statistics
+
+    from hpbandster_tpu.obs.timeline import (
+        RUNG_COMPUTE,
+        TimelineRecorder,
+        critical_path,
+        mark,
+        phase_span,
+        to_chrome_trace,
+    )
+    from hpbandster_tpu.optimizers import FusedBOHB
+    from hpbandster_tpu.workloads.toys import branin_from_vector, branin_space
+
+    def run_once(s, n_iterations=3):
+        cs = branin_space(seed=s)
+        opt = FusedBOHB(
+            configspace=cs, eval_fn=branin_from_vector,
+            run_id=f"bench-tl{s}", min_budget=1, max_budget=9, eta=3,
+            seed=s,
+        )
+        opt.run(n_iterations=n_iterations, device_metrics=True)
+        n = opt.total_evaluated
+        opt.shutdown()
+        return n
+
+    # --- micro: the inactive span API (recorder off = global bus has no
+    # sink in this process) and the per-record recorder-on cost
+    t0 = time.perf_counter()
+    for _ in range(n_micro):
+        with phase_span("bench_timeline_probe", RUNG_COMPUTE):
+            pass
+    span_inactive_ns = (time.perf_counter() - t0) / n_micro * 1e9
+    t0 = time.perf_counter()
+    for _ in range(n_micro):
+        mark("bench_timeline_probe", RUNG_COMPUTE)
+    mark_inactive_ns = (time.perf_counter() - t0) / n_micro * 1e9
+    with TimelineRecorder() as _probe_rec:
+        t0 = time.perf_counter()
+        for _ in range(n_micro):
+            mark("bench_timeline_probe", RUNG_COMPUTE)
+        record_ns = (time.perf_counter() - t0) / n_micro * 1e9
+    del _probe_rec
+
+    # --- exact record census of one warm sweep, recorder attached; the
+    # recorded journal then feeds the critical-path analyzer and the
+    # Chrome-trace assembly stats
+    run_once(seed + 99)  # warmup (compile never timed)
+    with TimelineRecorder() as rec:
+        n_evals = run_once(seed + 7777)
+    n_records = len(rec.records)
+    cp = critical_path(rec.records)
+    BUDGET_VERDICTS["timeline_critical_path"] = dict(cp["verdict"])
+    chrome_stats = {
+        k: v for k, v in to_chrome_trace(rec.records)["otherData"].items()
+        if k != "generator"
+    }
+
+    # --- warm wall + interleaved A/B cross-check (recorder on vs off)
+    def timed_block(recorder_on, seeds):
+        t0 = time.perf_counter()
+        if recorder_on:
+            with TimelineRecorder():
+                for s in seeds:
+                    run_once(s)
+        else:
+            for s in seeds:
+                run_once(s)
+        return time.perf_counter() - t0
+
+    t_on_total = t_off_total = 0.0
+    sweep_walls = []
+    for r in range(repeats):
+        seeds = [seed + r * inner + i for i in range(inner)]
+        for s in seeds:
+            run_once(s)
+        order = (True, False) if r % 2 == 0 else (False, True)
+        dt = {}
+        for recorder_on in order:
+            dt[recorder_on] = timed_block(recorder_on, seeds)
+        t_on_total += dt[True]
+        t_off_total += dt[False]
+        sweep_walls.append(dt[False] / max(len(seeds), 1))
+    sweep_s = statistics.median(sweep_walls) if sweep_walls else 0.0
+
+    # --- flat host-link assertion: same bracket geometry, growing config
+    # counts — the telemetry payload (rung_seq stamp included) must not
+    # move a byte
+    import jax as _jax
+    import numpy as _np
+
+    from hpbandster_tpu.ops.bracket import BracketPlan
+    from hpbandster_tpu.ops.sweep import build_space_codec, make_fused_sweep_fn
+
+    _codec = build_space_codec(branin_space(seed=seed))
+    payload_bytes = {}
+    for n in sizes:
+        _plans = [
+            BracketPlan((n, n // 3, n // 9), (1.0, 3.0, 9.0))
+        ] * 2
+        fn = make_fused_sweep_fn(
+            branin_from_vector, _plans, _codec,
+            min_points_in_model=2**30, device_metrics=True,
+        )
+        _, dm = _jax.device_get(fn(_np.uint32(seed)))
+        payload_bytes[str(n)] = int(sum(
+            _np.asarray(l).nbytes
+            for l in _jax.tree_util.tree_leaves(dm)
+        ))
+    if len(set(payload_bytes.values())) != 1:
+        raise RuntimeError(
+            "resident host-link bill is NOT flat: device-metrics payload "
+            "bytes grew with config count: %r" % payload_bytes
+        )
+
+    per_sweep_recorder_s = n_records * record_ns / 1e9
+    per_sweep_off_s = n_records * span_inactive_ns / 1e9
+    return {
+        "path": "fused sweep (FusedBOHB, 3 brackets, budgets 1..9, "
+                "device metrics on)",
+        "evaluations_per_sweep": n_evals,
+        "records_per_sweep": n_records,
+        "span_inactive_ns": round(span_inactive_ns, 1),
+        "mark_inactive_ns": round(mark_inactive_ns, 1),
+        "warm_sweep_s": round(sweep_s, 5),
+        # the gated number: what the timeline span API costs with the
+        # recorder OFF (no sink) — the path every run pays. Bar: < 2%.
+        "overhead_pct": round(
+            100.0 * per_sweep_off_s / sweep_s, 3
+        ) if sweep_s else None,
+        "recording": {
+            "record_ns": round(record_ns, 1),
+            "overhead_pct": round(
+                100.0 * per_sweep_recorder_s / sweep_s, 3
+            ) if sweep_s else None,
+            "note": "opt-in recording-session cost: Event construction "
+                    "(paid by ANY attached sink) + one list append, on "
+                    "the worst-case denominator (branin is ~one FLOP "
+                    "per eval, so the census sweep's wall is pure "
+                    "dispatch)",
+        },
+        "host_link_flat": {"payload_bytes": payload_bytes, "flat": True},
+        "critical_path": cp,
+        "chrome_trace": chrome_stats,
+        "ab_wall": {
+            "recorder_total_s": round(t_on_total, 4),
+            "bare_total_s": round(t_off_total, 4),
+            "overhead_pct_of_totals": round(
+                100.0 * (t_on_total - t_off_total) / t_off_total, 2
+            ) if t_off_total else None,
+            "note": "shared-host wall noise floor >> sub-percent effects; "
+                    "cross-check only",
+        },
+    }
+
+
 def bench_runtime_overhead(repeats=3, inner=100_000, seed=0):
     """Tracked-jit dispatch overhead (obs/runtime.py) under the <2% bar.
 
@@ -2682,6 +2871,9 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
             n_workers=2, n_iterations=1, repeats=1))
         obs_overhead = emit("obs_overhead", _run_tier(
             errors, "obs_overhead", bench_obs_overhead, repeats=repeats))
+        timeline_overhead = emit("timeline_overhead", _run_tier(
+            errors, "timeline_overhead", bench_timeline_overhead,
+            repeats=repeats, inner=2, n_micro=20_000, sizes=(256, 512)))
         runtime_overhead = emit("runtime_overhead", _run_tier(
             errors, "runtime_overhead", bench_runtime_overhead,
             inner=5_000))
@@ -2910,6 +3102,16 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
                  _run_tier(errors, "obs_overhead", bench_obs_overhead))
             if selected("obs_overhead") else dict(NOT_SELECTED)
         )
+        # backend-independent like obs_overhead: the flight recorder is a
+        # host-side bus sink, and its <2% claim (plus the flat host-link
+        # assertion and the critical-path verdict) must regenerate on the
+        # fallback path too
+        timeline_overhead = (
+            emit("timeline_overhead",
+                 _run_tier(errors, "timeline_overhead",
+                           bench_timeline_overhead))
+            if selected("timeline_overhead") else dict(NOT_SELECTED)
+        )
         # backend-independent like obs_overhead: tracked-jit dispatch and
         # the sampler census measure wherever the sweep runs, and the <2%
         # claim must regenerate on the fallback path too
@@ -3029,6 +3231,7 @@ def collect(backend_error=None, platform=None, smoke=False, tiers=None,
             "chaos_churn_10pct": chaos,
             "async_straggler_promotion": async_straggler,
             "obs_overhead_no_sink": obs_overhead,
+            "timeline_overhead_recorder": timeline_overhead,
             "runtime_overhead_tracked_jit": runtime_overhead,
             "collector_overhead_fleet_poll": collector_overhead,
             "report_100k_events": report_100k,

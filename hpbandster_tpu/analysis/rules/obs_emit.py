@@ -14,10 +14,12 @@ with, or passed into, a jit/vmap/pmap wrapper in this module). Inside a
 traced body it flags:
 
 * calls resolving through the import map into ``hpbandster_tpu.obs``
-  (``emit(...)``, ``span(...)``, ``obs.emit(...)``, aliased imports);
-* ``.emit(...)`` method calls — including on the result of
-  ``get_bus()`` — but only in modules that import ``hpbandster_tpu.obs``
-  at all, so unrelated ``.emit`` APIs elsewhere stay unflagged.
+  (``emit(...)``, ``span(...)``, ``obs.emit(...)``, the timeline span
+  API ``phase_span(...)``/``mark(...)``, aliased imports);
+* ``.emit(...)``, ``.phase_span(...)`` and ``.mark(...)`` method calls —
+  including on the result of ``get_bus()`` — but only in modules that
+  import ``hpbandster_tpu.obs`` at all, so unrelated APIs elsewhere
+  stay unflagged.
 """
 
 from __future__ import annotations
@@ -30,6 +32,12 @@ from hpbandster_tpu.analysis.rules._util import ImportMap, import_map_for
 from hpbandster_tpu.analysis.rules.jit_purity import traced_functions_for
 
 _OBS_PREFIX = "hpbandster_tpu.obs"
+
+#: emission-shaped attribute calls flagged in obs-importing modules:
+#: the bus API (``.emit``) and the timeline span API
+#: (``obs/timeline.py`` ``phase_span``/``mark``) — both are host clock
+#: reads + sink dispatch, equally wrong inside a traced body
+_EMIT_ATTRS = frozenset({"emit", "phase_span", "mark"})
 
 
 def _module_imports_obs(imports: ImportMap) -> bool:
@@ -49,9 +57,9 @@ def _resolves_to_obs(node: ast.expr, imports: ImportMap) -> bool:
 class ObsEmitInJitRule(Rule):
     name = "obs-emit-in-jit"
     description = (
-        "obs event emission (emit/span/bus.emit) inside a jit/vmap/pmap-ed "
-        "body — fires at trace time, not per execution; emit around the "
-        "jit boundary instead"
+        "obs event emission (emit/span/bus.emit or the timeline span API "
+        "phase_span/mark) inside a jit/vmap/pmap-ed body — fires at trace "
+        "time, not per execution; emit around the jit boundary instead"
     )
 
     def check(self, module: SourceModule) -> List[Finding]:
@@ -73,9 +81,11 @@ class ObsEmitInJitRule(Rule):
                 elif (
                     imports_obs
                     and isinstance(node.func, ast.Attribute)
-                    and node.func.attr == "emit"
+                    and node.func.attr in _EMIT_ATTRS
                 ):
-                    findings.append(self._flag(module, node, fn, ".emit()"))
+                    findings.append(
+                        self._flag(module, node, fn, f".{node.func.attr}()")
+                    )
         return findings
 
     def _flag(

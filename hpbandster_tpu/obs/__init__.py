@@ -119,6 +119,7 @@ from hpbandster_tpu.obs.events import (  # noqa: F401
     PROMOTION_DECISION,
     RESULT_DELIVERED,
     RESULT_REPLAYED,
+    RPC_CLIENT_CALL,
     RPC_RETRY,
     SWEEP_INCUMBENT,
     UNKNOWN_RESULT,
@@ -174,6 +175,25 @@ from hpbandster_tpu.obs.runtime import (  # noqa: F401
     tracked_jit,
     transfer_counters,
 )
+# KDE_REFIT deliberately not re-imported: the phase constant shares its
+# value with the already-exported event name (both "kde_refit")
+from hpbandster_tpu.obs.timeline import (  # noqa: F401
+    ADMISSION,
+    COMPILE,
+    PHASES,
+    PROMOTION,
+    RPC,
+    RUNG_COMPUTE,
+    TRANSFER,
+    TimelineRecorder,
+    align_clocks,
+    build_timeline,
+    critical_path,
+    format_critical_path,
+    mark,
+    phase_span,
+    to_chrome_trace,
+)
 from hpbandster_tpu.obs.trace import (  # noqa: F401
     DEFAULT_TENANT,
     TraceContext,
@@ -225,7 +245,12 @@ __all__ = [
     "FLEET_SAMPLE",
     "JOB_REQUEUED", "RESULT_REPLAYED", "DUPLICATE_RESULT",
     "WORKER_QUARANTINED", "CHAOS_FAULT", "SWEEP_INCUMBENT",
-    "DEVICE_TELEMETRY",
+    "DEVICE_TELEMETRY", "RPC_CLIENT_CALL",
+    "PHASES", "ADMISSION", "COMPILE", "TRANSFER", "RUNG_COMPUTE",
+    "PROMOTION", "RPC",
+    "phase_span", "mark", "TimelineRecorder", "align_clocks",
+    "build_timeline", "to_chrome_trace", "critical_path",
+    "format_critical_path",
 ]
 
 

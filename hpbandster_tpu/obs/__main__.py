@@ -11,6 +11,22 @@
   same journals are byte-identical. ``--tenant`` replays ONE tenant's
   slice of a multi-tenant serving journal (records without a
   ``tenant_id`` belong to ``default``).
+* ``timeline <journal> [<journal> ...] --out trace.json`` — the unified
+  sweep timeline (``obs/timeline.py``): every recorded signal — spans,
+  RPC hops, compile/dispatch events, lane lifecycle, decoded per-rung
+  device sections — assembled into one causally-ordered Chrome
+  trace-event JSON, loadable in Perfetto (https://ui.perfetto.dev) or
+  ``chrome://tracing``. Process rows per ``(host, pid)``, thread rows
+  per worker/lane, flow arrows following each ``trace_id`` across RPC
+  hops into the device loop. Cross-host clocks are aligned on each
+  record's monotonic/wall twin stamps before assembly.
+* ``critical-path <journal> [<journal> ...] [--json]`` — attribute the
+  journal's end-to-end wall-clock to named phases (admission wait,
+  compile, transfer, rung compute, promotion, KDE refit, RPC): a
+  per-phase table plus a machine-readable verdict (attributed share vs
+  threshold) — the same verdict ``bench.py``'s ``timeline_overhead``
+  tier records next to the budget verdicts. Exit 0 even when the
+  verdict fails (it reports, the bench gate enforces).
 * ``watch <journal> [--interval S] [--ticks N]`` — tail a live journal,
   one status line per tick; runs until ^C unless ``--ticks`` bounds it.
   ``watch --snapshot <uri> [--snapshot <uri> ...]`` polls live
@@ -273,6 +289,38 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="report one tenant's slice of a multi-tenant journal "
         "(records without tenant_id belong to 'default')",
     )
+    p_tl = sub.add_parser(
+        "timeline",
+        help="export the unified sweep timeline as Chrome trace-event "
+        "JSON (open in Perfetto or chrome://tracing); see "
+        "docs/observability.md 'Timeline & critical path'",
+    )
+    p_tl.add_argument(
+        "journals", nargs="+", metavar="journal",
+        help="JSONL run journal(s) — merged and clock-aligned first",
+    )
+    p_tl.add_argument(
+        "--out", metavar="PATH", default=None,
+        help="write the trace JSON here (default: stdout)",
+    )
+    p_cp = sub.add_parser(
+        "critical-path",
+        help="attribute end-to-end wall-clock to named phases (admission "
+        "wait, compile, transfer, rung compute, promotion, KDE refit, "
+        "RPC) with a machine-readable verdict",
+    )
+    p_cp.add_argument(
+        "journals", nargs="+", metavar="journal",
+        help="JSONL run journal(s) — merged and clock-aligned first",
+    )
+    p_cp.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the attribution (and verdict) as JSON instead of text",
+    )
+    p_cp.add_argument(
+        "--threshold", type=float, default=0.95,
+        help="attributed-share bar for the verdict (default 0.95)",
+    )
     p_rpl = sub.add_parser(
         "replay",
         help="re-score recorded promotion_decision records under another "
@@ -425,6 +473,37 @@ def main(argv: Optional[List[str]] = None) -> int:
     records = _read_checked(args.journals)
     if records is None:
         return 2
+    if args.command == "timeline":
+        from hpbandster_tpu.obs.timeline import to_chrome_trace
+
+        doc = to_chrome_trace(records)
+        payload = json.dumps(doc, indent=1, sort_keys=True)
+        stats = doc["otherData"]
+        if args.out is not None:
+            with open(args.out, "w", encoding="utf-8") as fh:
+                fh.write(payload + "\n")
+            print(
+                f"wrote {args.out}: {stats['slices']} slices, "
+                f"{stats['flows']} flow arrows, {stats['processes']} "
+                f"process row(s) over {stats['span_s']}s — open in "
+                "https://ui.perfetto.dev",
+                file=sys.stderr,
+            )
+        else:
+            print(payload)
+        return 0
+    if args.command == "critical-path":
+        from hpbandster_tpu.obs.timeline import (
+            critical_path,
+            format_critical_path,
+        )
+
+        cp = critical_path(records, threshold=args.threshold)
+        if args.as_json:
+            print(json.dumps(cp, indent=1, sort_keys=True))
+        else:
+            print(format_critical_path(cp))
+        return 0
     if args.command == "replay":
         # CLI-only import: the replay harness pulls in the promotion
         # kernels (numpy/jax); the substrate commands stay stdlib-only

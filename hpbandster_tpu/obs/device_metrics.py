@@ -269,6 +269,11 @@ def decode_device_metrics(
     by_budget: Dict[float, Dict[str, Any]] = {}
     per_bracket_best: List[Optional[float]] = []
     per_bracket_crashes: List[int] = []
+    #: per-rung execution-order entries (the ``rung_seq`` stamp the
+    #: device accumulator writes), assembled into the flat ``rung_order``
+    #: list the flight recorder (obs/timeline.py) lays device rows from
+    rung_order: List[Dict[str, Any]] = []
+    seq_offset = 0
 
     def budget_slot(b: float) -> Dict[str, Any]:
         return by_budget.setdefault(float(b), {
@@ -276,19 +281,26 @@ def decode_device_metrics(
             "hist": [0] * N_BINS,
         })
 
-    for metrics, shapes in parts:
+    for part_i, (metrics, shapes) in enumerate(parts):
         hist = np.asarray(metrics.loss_hist)
         evals = np.asarray(metrics.evals)
         crashes = np.asarray(metrics.crashes)
         promos = np.asarray(metrics.promotions)
         fits = np.asarray(metrics.model_fits)
         best = np.asarray(metrics.best_final)
+        # older pytrees (pre-rung_seq journals replayed through decode)
+        # carry no stamp: synthesize bracket-major order, which is what
+        # the unrolled sweep executes anyway
+        seq = getattr(metrics, "rung_seq", None)
+        seq = np.asarray(seq) if seq is not None else None
         if hist.shape[0] != len(shapes):
             raise ValueError(
                 f"metrics carry {hist.shape[0]} brackets but the plan "
                 f"schedule names {len(shapes)} — decode needs the exact "
                 "schedule the sweep ran"
             )
+        part_rungs = 0
+        part_entries: List[Dict[str, Any]] = []
         for b_i, (num_configs, budgets) in enumerate(shapes):
             n_brackets += 1
             total["model_fits"] += int(fits[b_i])
@@ -305,12 +317,33 @@ def decode_device_metrics(
                 total["crashes"] += int(crashes[b_i, s])
                 total["promotions"] += int(promos[b_i, s])
                 bracket_crashes += int(crashes[b_i, s])
+                s_raw = int(seq[b_i, s]) if seq is not None else part_rungs
+                if s_raw >= 0:
+                    part_entries.append({
+                        "seq": s_raw,
+                        "bracket": n_brackets - 1,
+                        "stage": s,
+                        "budget": float(budget),
+                        "evals": int(evals[b_i, s]),
+                    })
+                part_rungs += 1
             per_bracket_crashes.append(bracket_crashes)
             bf = float(best[b_i])
             per_bracket_best.append(
                 round(bf, 6) if bf == bf and finite_or_none(bf) is not None
                 else None
             )
+        # stack parts in execution order: rebase each part's stamps to
+        # its own minimum (a pytree SLICED out of a larger sweep keeps
+        # the sweep-global stamps; a fresh chunk starts at 0 — both land
+        # in the same place after the rebase), then offset by the rungs
+        # already decoded so chunked decodes order globally
+        if part_entries:
+            part_min = min(e["seq"] for e in part_entries)
+            for e in part_entries:
+                e["seq"] = e["seq"] - part_min + seq_offset
+            rung_order.extend(part_entries)
+        seq_offset += part_rungs
 
     # running incumbent after each bracket (crashed/NaN bests never
     # improve it) — the per-round improvement trail the ISSUE asks for
@@ -346,6 +379,18 @@ def decode_device_metrics(
             )
         rungs.append(slot)
 
+    # execution-order section: rungs sorted by the device stamp, each
+    # carrying its estimated device-seconds slice (same evals x budget
+    # work model as est_cost_s) so the timeline can lay the device row
+    # out to scale without any per-rung host timing existing
+    rung_order.sort(key=lambda r: (r["seq"], r["bracket"], r["stage"]))
+    if execute_s is not None and work_total > 0:
+        for r in rung_order:
+            r["est_s"] = round(
+                float(execute_s) * (r["evals"] * r["budget"] / work_total),
+                9,
+            )
+
     rec: Dict[str, Any] = {
         "schema": SCHEMA_VERSION,
         "n_bins": N_BINS,
@@ -360,6 +405,7 @@ def decode_device_metrics(
             if total["evals"] else None
         ),
         "rungs": rungs,
+        "rung_order": rung_order,
         "per_bracket_best": per_bracket_best,
         "per_bracket_crashes": per_bracket_crashes,
         "incumbent_after": incumbent_after,

@@ -68,6 +68,7 @@ __all__ = [
     "DEVICE_TELEMETRY",
     "LANE_ASSIGNED",
     "LANE_RELEASED",
+    "RPC_CLIENT_CALL",
 ]
 
 logger = logging.getLogger("hpbandster_tpu.obs")
@@ -136,6 +137,12 @@ DEVICE_TELEMETRY = "device_telemetry"
 #: returns to the free pool
 LANE_ASSIGNED = "lane_assigned"
 LANE_RELEASED = "lane_released"
+#: one client-side RPC round trip (parallel/rpc.py RPCProxy.call): a
+#: span-shaped record (``duration_s`` + ``method``) the flight recorder
+#: (obs/timeline.py) renders as an RPC-phase hop slice — emitted only
+#: when a sink listens, so the no-recorder RPC path pays one
+#: ``bus.active`` read and nothing else
+RPC_CLIENT_CALL = "rpc_client_call"
 
 #: the core vocabulary (docs/observability.md "Event schema"). emit() also
 #: accepts names outside this set — subsystems may add their own (span
@@ -147,7 +154,7 @@ EVENT_TYPES = frozenset({
     CONFIG_SAMPLED, PROMOTION_DECISION, ALERT, XLA_COMPILE, FLEET_SAMPLE,
     JOB_REQUEUED, RESULT_REPLAYED, DUPLICATE_RESULT, WORKER_QUARANTINED,
     CHAOS_FAULT, SWEEP_INCUMBENT, DEVICE_TELEMETRY, LANE_ASSIGNED,
-    LANE_RELEASED,
+    LANE_RELEASED, RPC_CLIENT_CALL,
 })
 
 #: process-wide kill switch (hpbandster_tpu.obs.set_enabled)

@@ -149,7 +149,10 @@ def trace_timelines(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     and delivery on the worker side, end-to-end back on the master), the
     hosts that contributed records, retry/failure flags, and the journal
     wall-clock span. Cross-trace aggregates ride along as
-    ``stage_latency_s``.
+    ``stage_latency_s``. Traces that ran on the fused/resident device
+    path additionally carry a ``device`` section — chunk/rung/evaluation
+    counts folded from their ``device_telemetry`` records — and a
+    ``device_s`` stage accumulating the measured execute windows.
     """
     traces: Dict[str, Dict[str, Any]] = {}
     for rec in records:
@@ -185,6 +188,27 @@ def trace_timelines(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             slot["failed"] = True
         elif name == E.UNKNOWN_RESULT:
             slot["dead_lettered"] = True
+        elif name == E.DEVICE_TELEMETRY:
+            # a fused/resident sweep's device sections belong to its
+            # trace: fold the decoded rung plane in so the timeline shows
+            # where the device window went instead of a gap (device_s
+            # accumulates — one record per chunk)
+            ex = rec.get("execute_s")
+            if isinstance(ex, (int, float)):
+                slot["stages"]["device_s"] = (
+                    slot["stages"].get("device_s", 0.0) + float(ex)
+                )
+            dev = slot.setdefault(
+                "device", {"chunks": 0, "rungs": 0, "evaluations": 0}
+            )
+            dev["chunks"] += 1
+            order = rec.get("rung_order")
+            if isinstance(order, list):
+                dev["rungs"] += len(order)
+                dev["evaluations"] += sum(
+                    int(e.get("evals", 0)) for e in order
+                    if isinstance(e, dict)
+                )
         for field, stage in _STAGE_FIELDS:
             v = rec.get(field)
             if isinstance(v, (int, float)):

@@ -668,11 +668,19 @@ class DeviceMetrics(NamedTuple):
     #: same crash-rank ordering as the incumbent fold); the decoder
     #: derives the running incumbent / improvement deltas from it
     best_final: jax.Array  # f32[n_brackets]
+    #: per-(bracket, rung) monotonically increasing sequence stamp: the
+    #: rung's global position in the sweep's execution order (-1 = the
+    #: rung never ran). The stamp is what lets the flight recorder
+    #: (``obs/timeline.py``) lay resident-scan rungs out in true device
+    #: order — the scan's stacked outputs lose it — and it rides the
+    #: same O(schedule) payload, so the flat d2h bill is untouched
+    rung_seq: jax.Array    # i32[n_brackets, max_rungs]
 
 
 def init_device_metrics(n_brackets: int, max_rungs: int, n_bins: int) -> DeviceMetrics:
     """Zero-initialized metrics carry (``best_final`` inits to NaN — a
-    bracket that has not run yet has no best)."""
+    bracket that has not run yet has no best; ``rung_seq`` inits to -1 —
+    a rung that never ran has no position in the execution order)."""
     return DeviceMetrics(
         loss_hist=jnp.zeros((n_brackets, max_rungs, n_bins), jnp.int32),
         evals=jnp.zeros((n_brackets, max_rungs), jnp.int32),
@@ -680,6 +688,7 @@ def init_device_metrics(n_brackets: int, max_rungs: int, n_bins: int) -> DeviceM
         promotions=jnp.zeros((n_brackets, max_rungs), jnp.int32),
         model_fits=jnp.zeros((n_brackets,), jnp.int32),
         best_final=jnp.full((n_brackets,), jnp.nan, jnp.float32),
+        rung_seq=jnp.full((n_brackets, max_rungs), -1, jnp.int32),
     )
 
 
@@ -1030,6 +1039,16 @@ def make_fused_sweep_fn(
         dm_edges = bin_edges().astype(np.float32)
         dm_rungs = max(len(p.num_configs) for p in plans) if plans else 0
         dm_bins = N_BINS
+        # per-bracket base of the global rung sequence stamp: cumulative
+        # rung counts over the STATIC schedule, indexed at (possibly
+        # traced) b_i inside run_bracket — the resident scan's bracket
+        # index is a scalar i32, and gathering from a static table is
+        # how the stamp stays monotonic across rounds without carrying
+        # an extra counter through the scan
+        dm_seq_base = jnp.asarray(
+            np.cumsum([0] + [len(p.num_configs) for p in plans])[:-1],
+            jnp.int32,
+        )
 
     def trained_split(n: int) -> Optional[Tuple[int, int]]:
         """Host-side static twin of the _fit_kde_pair gate."""
@@ -1324,12 +1343,13 @@ def make_fused_sweep_fn(
             out_vectors = jnp.where(active, vectors, jnp.nan)
         else:
             eval_vectors = out_vectors = vectors
-        if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            eval_vectors = jax.lax.with_sharding_constraint(
-                eval_vectors, NamedSharding(mesh, PartitionSpec(axis))
-            )
+        # shard_rows, NOT a raw with_sharding_constraint: constraining a
+        # batch that does not divide the config axis miscompiles under
+        # XLA CPU SPMD on multi-axis meshes (stage indices come back
+        # scaled by the other axis' size — the __graft_entry__ dryrun's
+        # (config, model) mesh with a 9-row bracket), and shard_rows is
+        # the one place that divisibility policy lives
+        eval_vectors = shard_rows(eval_vectors, mesh, axis)
 
         stages = fused_sh_bracket(
             eval_fn, eval_vectors, plan.num_configs, plan.budgets,
@@ -1368,9 +1388,9 @@ def make_fused_sweep_fn(
             # contract extends to telemetry). O(n) binning per stage is
             # trivial next to the stage evaluation it accompanies; the
             # carried arrays are O(schedule), never O(configs).
-            m_hist, m_ev, m_cr, m_pr = (
+            m_hist, m_ev, m_cr, m_pr, m_sq = (
                 metrics.loss_hist, metrics.evals, metrics.crashes,
-                metrics.promotions,
+                metrics.promotions, metrics.rung_seq,
             )
             depth = len(plan.num_configs)
             for s, ((_idx_s, losses_s), k_s) in enumerate(
@@ -1383,6 +1403,11 @@ def make_fused_sweep_fn(
                 m_pr = m_pr.at[b_i, s].set(
                     plan.num_configs[s + 1] if s + 1 < depth else 0
                 )
+                # global execution-order stamp: static per-bracket base
+                # (gathered at the concrete-or-traced b_i) + the stage
+                # offset — monotonically increasing over the whole
+                # schedule, resident rounds included
+                m_sq = m_sq.at[b_i, s].set(dm_seq_base[b_i] + s)
             _, loss_fin = stages[-1]
             key_fin = jnp.where(jnp.isnan(loss_fin), _CRASH_RANK, loss_fin)
             metrics = DeviceMetrics(
@@ -1392,6 +1417,7 @@ def make_fused_sweep_fn(
                 best_final=metrics.best_final.at[b_i].set(
                     loss_fin[jnp.argmin(key_fin)]
                 ),
+                rung_seq=m_sq,
             )
 
         out = None

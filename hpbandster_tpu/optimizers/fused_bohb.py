@@ -519,69 +519,93 @@ class FusedBOHB:
 
         from hpbandster_tpu.utils.profiling import trace
 
+        from hpbandster_tpu.obs.timeline import (
+            ADMISSION,
+            COMPILE,
+            PROMOTION,
+            TRANSFER,
+            phase_span,
+        )
+
         first = len(self.iterations)
-        plans = [self._plan(i) for i in range(first, int(n_iterations))]
-        if self.config["time_ref"] is None:
-            self.config["time_ref"] = time.time()
+        # planning is the sweep's admission work: schedule geometry +
+        # bracket_created records, before anything boards the device
+        with phase_span("sweep_planning", ADMISSION):
+            plans = [self._plan(i) for i in range(first, int(n_iterations))]
+        # everything between planning and the first dispatch — mesh
+        # probing, tier policy, trace mint, transfer baselines — is
+        # still admission work on the timeline
+        with phase_span("sweep_setup", ADMISSION):
+            if self.config["time_ref"] is None:
+                self.config["time_ref"] = time.time()
 
-        from hpbandster_tpu.parallel.mesh import is_multiprocess_mesh
+            from hpbandster_tpu.parallel.mesh import is_multiprocess_mesh
 
-        multiprocess = is_multiprocess_mesh(self.mesh)
-        if resident and chunk_brackets is not None:
-            raise ValueError(
-                "resident=True replaces chunking (the whole schedule is one "
-                "scanned program) — drop chunk_brackets"
+            multiprocess = is_multiprocess_mesh(self.mesh)
+            if resident and chunk_brackets is not None:
+                raise ValueError(
+                    "resident=True replaces chunking (the whole schedule is one "
+                    "scanned program) — drop chunk_brackets"
+                )
+            if resident and dynamic_counts is False:
+                raise ValueError(
+                    "resident=True requires the dynamic-count tier (observation "
+                    "counts are scan carry) — drop dynamic_counts=False"
+                )
+            chunk = len(plans) if chunk_brackets is None else max(int(chunk_brackets), 1)
+            # dynamic-count policy: chunked mode IS the compile-reuse tier. The
+            # choice must not peek at the remaining schedule length — a run
+            # killed after its first chunk and a longer uninterrupted run must
+            # execute bit-identical first chunks for the checkpoint resume
+            # guarantee to hold, so only the caller-visible chunking knob (and
+            # nothing derived from how many brackets remain) may select the tier
+            dynamic = resident or (
+                (chunk_brackets is not None)
+                if dynamic_counts is None else bool(dynamic_counts)
             )
-        if resident and dynamic_counts is False:
-            raise ValueError(
-                "resident=True requires the dynamic-count tier (observation "
-                "counts are scan carry) — drop dynamic_counts=False"
+            from hpbandster_tpu.obs.device_metrics import device_metrics_default
+
+            use_dm = (
+                device_metrics_default()
+                if device_metrics is None else bool(device_metrics)
             )
-        chunk = len(plans) if chunk_brackets is None else max(int(chunk_brackets), 1)
-        # dynamic-count policy: chunked mode IS the compile-reuse tier. The
-        # choice must not peek at the remaining schedule length — a run
-        # killed after its first chunk and a longer uninterrupted run must
-        # execute bit-identical first chunks for the checkpoint resume
-        # guarantee to hold, so only the caller-visible chunking knob (and
-        # nothing derived from how many brackets remain) may select the tier
-        dynamic = resident or (
-            (chunk_brackets is not None)
-            if dynamic_counts is None else bool(dynamic_counts)
-        )
-        from hpbandster_tpu.obs.device_metrics import device_metrics_default
+            #: fetched per-chunk metrics pytrees + their bracket schedules —
+            #: decoded once at the end of the run into ONE telemetry record
+            dm_parts: List[Any] = []
+            dm_execute_s = 0.0
+            #: one trace identity for this run() call's whole sweep: every
+            #: chunk span, compile event and the decoded device-telemetry
+            #: record share it, so the flight recorder (obs/timeline.py) and
+            #: summarize's trace_timelines can stitch the fused sweep — host
+            #: phases AND the device loop — into one per-trace timeline. An
+            #: already-active trace (a serving layer driving this run) wins.
+            from hpbandster_tpu.obs.trace import current_trace, new_trace, use_trace
 
-        use_dm = (
-            device_metrics_default()
-            if device_metrics is None else bool(device_metrics)
-        )
-        #: fetched per-chunk metrics pytrees + their bracket schedules —
-        #: decoded once at the end of the run into ONE telemetry record
-        dm_parts: List[Any] = []
-        dm_execute_s = 0.0
-        link0 = None
-        if plans:
-            from hpbandster_tpu.obs.runtime import transfer_counters
+            sweep_trace = current_trace() or new_trace(self.run_id)
+            link0 = None
+            if plans:
+                from hpbandster_tpu.obs.runtime import transfer_counters
 
-            link0 = transfer_counters()
-        d = int(self.codec.kind.shape[0])
-        done = first
-        #: deferred host bookkeeping of the PREVIOUS chunk: replaying the
-        #: reference-shaped Datum/SuccessiveHalving state machine is the
-        #: expensive host-path term (docs/perf_notes.md, ~20% of warm
-        #: wall), and the NEXT chunk's device inputs only need the cheap
-        #: _accumulate_obs fold — so the replay runs while the device
-        #: executes the next chunk instead of serializing with it
-        pending_replay = None
-        overlap_s = None
-        #: device-resident observation state threaded between dynamic
-        #: chunks (the return_state/donation contract, ops/sweep.py): the
-        #: previous chunk's returned (obs_v, obs_l, counts) pytrees feed
-        #: the next call directly — donated, so XLA updates the buffers in
-        #: place and the warm state never round-trips through the host.
-        #: Invalidated when a capacity bucket doubles (shapes changed);
-        #: the host fold (_accumulate_obs) then rebuilds identical values.
-        dev_state = None
-        dev_caps = None
+                link0 = transfer_counters()
+            d = int(self.codec.kind.shape[0])
+            done = first
+            #: deferred host bookkeeping of the PREVIOUS chunk: replaying the
+            #: reference-shaped Datum/SuccessiveHalving state machine is the
+            #: expensive host-path term (docs/perf_notes.md, ~20% of warm
+            #: wall), and the NEXT chunk's device inputs only need the cheap
+            #: _accumulate_obs fold — so the replay runs while the device
+            #: executes the next chunk instead of serializing with it
+            pending_replay = None
+            overlap_s = None
+            #: device-resident observation state threaded between dynamic
+            #: chunks (the return_state/donation contract, ops/sweep.py): the
+            #: previous chunk's returned (obs_v, obs_l, counts) pytrees feed
+            #: the next call directly — donated, so XLA updates the buffers in
+            #: place and the warm state never round-trips through the host.
+            #: Invalidated when a capacity bucket doubles (shapes changed);
+            #: the host fold (_accumulate_obs) then rebuilds identical values.
+            dev_state = None
+            dev_caps = None
 
         def _flush_replay():
             """Idempotent: runs the deferred replay exactly once. Clears
@@ -605,103 +629,113 @@ class FusedBOHB:
             #: cannot see them)
             streamed_bytes = 0
             try:
-                run_caps = None
-                if dynamic:
-                    # PAST-ONLY capacities, pow2-bucketed with a generous
-                    # floor: warm counts at this chunk boundary + this chunk's
-                    # additions, rounded up. Two runs that agree on history
-                    # agree on every chunk's buffer shapes regardless of how
-                    # much schedule lies ahead (the resume guarantee), and
-                    # consecutive chunks reuse one executable until a bucket
-                    # doubles. The 256 floor makes doublings RARE: any run
-                    # under 256 observations per budget is one compile total,
-                    # and a 10k-config sweep crosses ~6 boundaries — where a
-                    # floor-of-8 bucket spent the whole small-run regime in
-                    # doubling-dense territory and recompiled almost every
-                    # chunk (measured: 8 compiles/9 chunks). Masked model math
-                    # over >=256 rows is trivial device work next to that.
-                    run_caps = {
-                        float(b): len(l) for b, l in self._warm_l.items()
-                    }
-                    for b, k in plan_additions(chunk_plans).items():
-                        run_caps[b] = run_caps.get(b, 0) + k
-                    run_caps = pow2_capacities(run_caps)
-                    if dev_state is not None and run_caps == dev_caps:
-                        # same buffer shapes: hand the previous chunk's
-                        # device state straight back — zero warm-state
-                        # bytes cross the host link
-                        args = (seed,) + dev_state
-                    elif self._can_stream_warm(multiprocess, run_caps):
-                        # sharded mesh: warm buffers stream up PER SHARD
-                        # SLICE — the full-capacity array (1M+ rows at the
-                        # fused_1M scale) never materializes on host in
-                        # one piece (ISSUE 10: bounded peak host RSS,
-                        # probed by the bench tier)
-                        args, streamed_bytes = self._stream_warm_args(
-                            seed, run_caps, d
-                        )
-                        dev_state = None  # stale shapes: never reuse
+                # the staging window: warm-buffer padding / streaming,
+                # transfer-ledger accounting, replicated-array wrapping
+                # -- the host cost of putting this chunk's inputs on the
+                # device link (the flight recorder's h2d counterpart of
+                # telemetry_fetch)
+                with phase_span("chunk_staging", TRANSFER):
+                    run_caps = None
+                    if dynamic:
+                        # PAST-ONLY capacities, pow2-bucketed with a generous
+                        # floor: warm counts at this chunk boundary + this chunk's
+                        # additions, rounded up. Two runs that agree on history
+                        # agree on every chunk's buffer shapes regardless of how
+                        # much schedule lies ahead (the resume guarantee), and
+                        # consecutive chunks reuse one executable until a bucket
+                        # doubles. The 256 floor makes doublings RARE: any run
+                        # under 256 observations per budget is one compile total,
+                        # and a 10k-config sweep crosses ~6 boundaries — where a
+                        # floor-of-8 bucket spent the whole small-run regime in
+                        # doubling-dense territory and recompiled almost every
+                        # chunk (measured: 8 compiles/9 chunks). Masked model math
+                        # over >=256 rows is trivial device work next to that.
+                        run_caps = {
+                            float(b): len(l) for b, l in self._warm_l.items()
+                        }
+                        for b, k in plan_additions(chunk_plans).items():
+                            run_caps[b] = run_caps.get(b, 0) + k
+                        run_caps = pow2_capacities(run_caps)
+                        if dev_state is not None and run_caps == dev_caps:
+                            # same buffer shapes: hand the previous chunk's
+                            # device state straight back — zero warm-state
+                            # bytes cross the host link
+                            args = (seed,) + dev_state
+                        elif self._can_stream_warm(multiprocess, run_caps):
+                            # sharded mesh: warm buffers stream up PER SHARD
+                            # SLICE — the full-capacity array (1M+ rows at the
+                            # fused_1M scale) never materializes on host in
+                            # one piece (ISSUE 10: bounded peak host RSS,
+                            # probed by the bench tier)
+                            args, streamed_bytes = self._stream_warm_args(
+                                seed, run_caps, d
+                            )
+                            dev_state = None  # stale shapes: never reuse
+                        else:
+                            warm_v_pad, warm_l_pad, warm_n = {}, {}, {}
+                            for b, cap in run_caps.items():
+                                v = self._warm_v.get(b)
+                                n = 0 if v is None else len(v)
+                                buf_v = np.zeros((cap, d), np.float32)
+                                buf_l = np.full(cap, np.inf, np.float32)
+                                if n:
+                                    buf_v[:n] = v
+                                    buf_l[:n] = self._warm_l[b]
+                                warm_v_pad[b] = buf_v
+                                warm_l_pad[b] = buf_l
+                                warm_n[b] = np.int32(n)
+                            args = (seed, warm_v_pad, warm_l_pad, warm_n)
+                            dev_state = None  # stale shapes: never reuse
                     else:
-                        warm_v_pad, warm_l_pad, warm_n = {}, {}, {}
-                        for b, cap in run_caps.items():
-                            v = self._warm_v.get(b)
-                            n = 0 if v is None else len(v)
-                            buf_v = np.zeros((cap, d), np.float32)
-                            buf_l = np.full(cap, np.inf, np.float32)
-                            if n:
-                                buf_v[:n] = v
-                                buf_l[:n] = self._warm_l[b]
-                            warm_v_pad[b] = buf_v
-                            warm_l_pad[b] = buf_l
-                            warm_n[b] = np.int32(n)
-                        args = (seed, warm_v_pad, warm_l_pad, warm_n)
-                        dev_state = None  # stale shapes: never reuse
-                else:
-                    args = (
-                        (seed, self._warm_v, self._warm_l)
-                        if self._warm_l else (seed,)
-                    )
-                # the budget gate's transfer ledger: bytes the host link
-                # actually carries this chunk — measured BEFORE any
-                # to_global conversion below wraps the numpy leaves in jax
-                # Arrays (measuring after would read 0 on the DCN tier).
-                # Device-resident state leaves cost nothing: that is the
-                # state-threading win.
-                upload_bytes = streamed_bytes + sum(
-                    int(getattr(l, "nbytes", 0))
-                    for l in jax.tree_util.tree_leaves(args)
-                    if not isinstance(l, jax.Array)
-                )
-                if multiprocess:
-                    # DCN tier: host-local numpy args become GLOBAL replicated
-                    # arrays (every rank holds identical values — the SPMD
-                    # drivers run the same deterministic control flow), matching
-                    # the sweep executable's replicated in_shardings. Leaves
-                    # that are already jax Arrays (the threaded device state)
-                    # pass through untouched — they carry the right sharding
-                    # from the previous call's out_shardings.
-                    from jax.sharding import NamedSharding, PartitionSpec
-
-                    rep = NamedSharding(self.mesh, PartitionSpec())
-
-                    def to_global(x):
-                        if isinstance(x, jax.Array):
-                            return x
-                        arr = np.asarray(x)
-                        return jax.make_array_from_callback(
-                            arr.shape, rep, lambda idx: arr[idx]
+                        args = (
+                            (seed, self._warm_v, self._warm_l)
+                            if self._warm_l else (seed,)
                         )
-
-                    args = jax.tree.map(to_global, args)
-                from hpbandster_tpu.obs.runtime import note_transfer
-
-                note_transfer("h2d", upload_bytes)
-                with trace(profile_dir):
-                    compiled, compile_s, cache_hit = self._sweep_compiled(
-                        tuple(chunk_plans), args, dynamic=dynamic,
-                        caps=run_caps, resident=resident,
-                        device_metrics=use_dm,
+                    # the budget gate's transfer ledger: bytes the host link
+                    # actually carries this chunk — measured BEFORE any
+                    # to_global conversion below wraps the numpy leaves in jax
+                    # Arrays (measuring after would read 0 on the DCN tier).
+                    # Device-resident state leaves cost nothing: that is the
+                    # state-threading win.
+                    upload_bytes = streamed_bytes + sum(
+                        int(getattr(l, "nbytes", 0))
+                        for l in jax.tree_util.tree_leaves(args)
+                        if not isinstance(l, jax.Array)
                     )
+                    if multiprocess:
+                        # DCN tier: host-local numpy args become GLOBAL replicated
+                        # arrays (every rank holds identical values — the SPMD
+                        # drivers run the same deterministic control flow), matching
+                        # the sweep executable's replicated in_shardings. Leaves
+                        # that are already jax Arrays (the threaded device state)
+                        # pass through untouched — they carry the right sharding
+                        # from the previous call's out_shardings.
+                        from jax.sharding import NamedSharding, PartitionSpec
+
+                        rep = NamedSharding(self.mesh, PartitionSpec())
+
+                        def to_global(x):
+                            if isinstance(x, jax.Array):
+                                return x
+                            arr = np.asarray(x)
+                            return jax.make_array_from_callback(
+                                arr.shape, rep, lambda idx: arr[idx]
+                            )
+
+                        args = jax.tree.map(to_global, args)
+                    from hpbandster_tpu.obs.runtime import note_transfer
+
+                    note_transfer("h2d", upload_bytes)
+                with trace(profile_dir), use_trace(sweep_trace):
+                    # on a ledger miss this window is the real trace+build
+                    # wall (also reported as compile_s on the chunk
+                    # record); on a hit, the lookup itself
+                    with phase_span("compile_lookup", COMPILE):
+                        compiled, compile_s, cache_hit = self._sweep_compiled(
+                            tuple(chunk_plans), args, dynamic=dynamic,
+                            caps=run_caps, resident=resident,
+                            device_metrics=use_dm,
+                        )
                     t_exec = time.perf_counter()
                     raw = compiled(*args)  # async dispatch
                     dm_dev = None
@@ -720,11 +754,21 @@ class FusedBOHB:
                     _flush_replay()
                     outputs = jax.device_get(raw)
                     if dm_dev is not None:
-                        dm_parts.append((
-                            jax.device_get(dm_dev),
-                            [(p.num_configs, p.budgets)
-                             for p in chunk_plans],
-                        ))
+                        # outputs already synced above, so this fetch is
+                        # pure d2h of the O(schedule) telemetry pytree —
+                        # the one transfer-phase slice the fused journal
+                        # can measure honestly
+                        from hpbandster_tpu.obs.timeline import (
+                            TRANSFER,
+                            phase_span,
+                        )
+
+                        with phase_span("telemetry_fetch", TRANSFER):
+                            dm_parts.append((
+                                jax.device_get(dm_dev),
+                                [(p.num_configs, p.budgets)
+                                 for p in chunk_plans],
+                            ))
                     # span of the device phase (dispatch -> fetch complete).
                     # When the overlapped replay outlasts the device work this
                     # OVERSTATES device-busy seconds, so derived MFU reads
@@ -776,60 +820,71 @@ class FusedBOHB:
                     )
             from hpbandster_tpu.ops.fused import _unpack_stages
 
-            stat = {
-                "chunk_index": len(self.run_stats),
-                "brackets": list(range(done, done + len(chunk_plans))),
-                "evaluations": int(
-                    sum(sum(p.num_configs) for p in chunk_plans)
-                ),
-                "build_compile_s": round(compile_s, 4),
-                "compile_cache_hit": cache_hit,
-                "execute_fetch_s": round(execute_s, 4),
-                "dynamic_counts": bool(dynamic),
-                # where this chunk's warm observations came from: 0 bytes
-                # uploaded = the donated device thread carried them
-                "warm_upload_bytes": int(upload_bytes),
-            }
-            if overlap_s is not None:
-                # host replay of the PRIOR chunk that ran inside this
-                # chunk's device window
-                stat["replay_overlap_s"] = round(overlap_s, 4)
-            self.run_stats.append(stat)
-            # one span-shaped event per device chunk: the journal's view of
-            # the fused tier (duration = dispatch -> fetch; compile split
-            # out; h2d/d2h byte fields feed the summarize host-link section)
-            obs.emit(
-                "sweep_chunk",
-                duration_s=stat["execute_fetch_s"],
-                compile_s=stat["build_compile_s"],
-                compile_cache_hit=cache_hit,
-                evaluations=stat["evaluations"],
-                brackets=stat["brackets"],
-                h2d_bytes=int(upload_bytes),
-                d2h_bytes=int(d2h_bytes),
-            )
-            # per-job device-timing attribution (VERDICT r1 #10): every run
-            # of this chunk carries the chunk's compile/execute seconds into
-            # Result.info / results.json, so BASELINE claims reproduce from
-            # run artifacts alone
-            job_info = {
-                "fused_chunk": stat["chunk_index"],
-                "chunk_compile_s": stat["build_compile_s"],
-                "chunk_compile_cache_hit": cache_hit,
-                "chunk_execute_s": stat["execute_fetch_s"],
-                "chunk_evaluations": stat["evaluations"],
-            }
+            # chunk accounting — run_stats row, the sweep_chunk journal
+            # record (and its sink write), per-job attribution info —
+            # is host bookkeeping the timeline charges to promotion
+            with phase_span("chunk_accounting", PROMOTION):
+                stat = {
+                    "chunk_index": len(self.run_stats),
+                    "brackets": list(range(done, done + len(chunk_plans))),
+                    "evaluations": int(
+                        sum(sum(p.num_configs) for p in chunk_plans)
+                    ),
+                    "build_compile_s": round(compile_s, 4),
+                    "compile_cache_hit": cache_hit,
+                    "execute_fetch_s": round(execute_s, 4),
+                    "dynamic_counts": bool(dynamic),
+                    # where this chunk's warm observations came from: 0 bytes
+                    # uploaded = the donated device thread carried them
+                    "warm_upload_bytes": int(upload_bytes),
+                }
+                if overlap_s is not None:
+                    # host replay of the PRIOR chunk that ran inside this
+                    # chunk's device window
+                    stat["replay_overlap_s"] = round(overlap_s, 4)
+                self.run_stats.append(stat)
+                # one span-shaped event per device chunk: the journal's view of
+                # the fused tier (duration = dispatch -> fetch; compile split
+                # out; h2d/d2h byte fields feed the summarize host-link section)
+                with use_trace(sweep_trace):
+                    obs.emit(
+                        "sweep_chunk",
+                        duration_s=stat["execute_fetch_s"],
+                        compile_s=stat["build_compile_s"],
+                        compile_cache_hit=cache_hit,
+                        evaluations=stat["evaluations"],
+                        brackets=stat["brackets"],
+                        seq=stat["chunk_index"],
+                        h2d_bytes=int(upload_bytes),
+                        d2h_bytes=int(d2h_bytes),
+                    )
+                # per-job device-timing attribution (VERDICT r1 #10): every run
+                # of this chunk carries the chunk's compile/execute seconds into
+                # Result.info / results.json, so BASELINE claims reproduce from
+                # run artifacts alone
+                job_info = {
+                    "fused_chunk": stat["chunk_index"],
+                    "chunk_compile_s": stat["build_compile_s"],
+                    "chunk_compile_cache_hit": cache_hit,
+                    "chunk_execute_s": stat["execute_fetch_s"],
+                    "chunk_evaluations": stat["evaluations"],
+                }
 
             staged = []
-            for b_i, (plan, out) in enumerate(zip(chunk_plans, outputs), start=done):
-                stages = _unpack_stages(
-                    (out.idx_packed, out.loss_packed), plan.num_configs
-                )
-                staged.append((b_i, plan, out, stages))
-                # accumulated EAGERLY: later chunks AND later run() calls
-                # consume these as warm data — the model, like the
-                # Master's, sees all past results
-                self._accumulate_obs(plan, out, stages)
+            # the eager observation fold is successive-halving bookkeeping
+            # on the host path — a promotion-phase slice on the timeline
+            with phase_span("obs_fold", PROMOTION):
+                for b_i, (plan, out) in enumerate(
+                    zip(chunk_plans, outputs), start=done
+                ):
+                    stages = _unpack_stages(
+                        (out.idx_packed, out.loss_packed), plan.num_configs
+                    )
+                    staged.append((b_i, plan, out, stages))
+                    # accumulated EAGERLY: later chunks AND later run()
+                    # calls consume these as warm data — the model, like
+                    # the Master's, sees all past results
+                    self._accumulate_obs(plan, out, stages)
 
             def replay_now(staged=staged, job_info=job_info):
                 for b_i, plan, out, stages in staged:
@@ -843,12 +898,16 @@ class FusedBOHB:
                 # boundary, so checkpointed runs replay sequentially —
                 # resume-equals-uninterrupted stays bitwise either way
                 # (replay content never depends on when it runs)
-                replay_now()
+                with phase_span("bracket_replay", PROMOTION):
+                    replay_now()
                 self.save_checkpoint(checkpoint_path)
             else:
                 pending_replay = replay_now
         if pending_replay is not None:
-            pending_replay()  # last chunk has no successor to hide behind
+            # last chunk has no successor to hide behind; the replay is
+            # promotion bookkeeping, so the timeline charges it there
+            with phase_span("bracket_replay", PROMOTION):
+                pending_replay()
         if link0 is not None:
             # per-sweep host-link gauges (sweep.transfer_bytes.{h2d,d2h},
             # sweep.host_syncs): this run() call's whole transfer bill
@@ -870,7 +929,11 @@ class FusedBOHB:
                 dm_parts, execute_s=dm_execute_s
             )
             publish_device_metrics(decoded)
-            emit_device_telemetry(decoded)
+            # journaled under the sweep's trace: the device loop's rung
+            # sections join the same per-trace timeline as the host-side
+            # chunk spans (summarize trace_timelines / obs timeline)
+            with use_trace(sweep_trace):
+                emit_device_telemetry(decoded)
             self.last_device_telemetry = decoded
         self._write_timings_sidecar()
         return Result(
@@ -993,16 +1056,32 @@ class FusedBOHB:
         loss = float(np.asarray(inc.loss))
         bracket = int(np.asarray(inc.bracket))
         per_bracket = [float(x) for x in np.asarray(inc.per_bracket_loss)]
-        obs.emit_sweep_incumbent(
-            vector=vector,
-            loss=loss,
-            bracket=bracket,
-            per_bracket_loss=per_bracket,
-            evaluations=evaluations,
-            d2h_bytes=link["transfer_bytes_d2h"],
-            h2d_bytes=link["transfer_bytes_h2d"],
-            host_syncs=link["transfers_h2d"] + link["transfers_d2h"],
-        )
+        from hpbandster_tpu.obs.trace import current_trace, new_trace, use_trace
+
+        inc_trace = current_trace() or new_trace(self.run_id)
+        with use_trace(inc_trace):
+            # span-shaped device slice: the resident sweep is one chunk,
+            # so the flight recorder gets a rung_compute interval to lay
+            # the decoded per-rung sections onto
+            obs.emit(
+                "sweep_chunk",
+                duration_s=round(execute_s, 4),
+                compile_s=round(compile_s, 4),
+                compile_cache_hit=cache_hit,
+                evaluations=evaluations,
+                brackets=list(range(len(plans))),
+                seq=0,
+            )
+            obs.emit_sweep_incumbent(
+                vector=vector,
+                loss=loss,
+                bracket=bracket,
+                per_bracket_loss=per_bracket,
+                evaluations=evaluations,
+                d2h_bytes=link["transfer_bytes_d2h"],
+                h2d_bytes=link["transfer_bytes_h2d"],
+                host_syncs=link["transfers_h2d"] + link["transfers_d2h"],
+            )
         out = {
             "incumbent": {
                 "vector": vector,
@@ -1027,7 +1106,8 @@ class FusedBOHB:
                 dm_host, plans=plans, execute_s=execute_s
             )
             publish_device_metrics(decoded)
-            emit_device_telemetry(decoded)
+            with use_trace(inc_trace):
+                emit_device_telemetry(decoded)
             self.last_device_telemetry = decoded
             out["device_telemetry"] = decoded
         return out

@@ -27,6 +27,7 @@ import threading
 import traceback
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from hpbandster_tpu.obs import events as obs_events
 from hpbandster_tpu.obs import get_metrics
 from hpbandster_tpu.obs.trace import (
     WIRE_FIELD,
@@ -210,9 +211,15 @@ class RPCProxy:
         payload = json.dumps(msg).encode("utf-8")
         _count("rpc.client_calls")
         try:
-            with socket.create_connection(self.addr, timeout=self.timeout) as sock:
-                sock.sendall(payload + b"\n")
-                raw = _read_frame(sock)
+            # the flight-recorder hop span (obs/timeline.py renders it as
+            # the RPC-phase slice of a trace's row): span() is near-free
+            # when no sink listens — no clock reads, no event
+            with obs_events.span(obs_events.RPC_CLIENT_CALL, method=method):
+                with socket.create_connection(
+                    self.addr, timeout=self.timeout
+                ) as sock:
+                    sock.sendall(payload + b"\n")
+                    raw = _read_frame(sock)
         except CommunicationError:
             # _read_frame's own failures (truncated / oversized frame) are
             # communication errors too — count them like every other one

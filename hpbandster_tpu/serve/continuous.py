@@ -485,6 +485,8 @@ class ContinuousRunner:
             m.gauge(f"serve.family.{self.family}.lanes_starved").set(
                 self._last_starved
             )
+            chunk_seq = self.chunks_run - 1
+            t_dispatch = time.monotonic()
 
         def fetch():
             fetched = jax.device_get(
@@ -523,6 +525,18 @@ class ContinuousRunner:
                     if rec is not None:
                         publish_device_metrics(rec)
                         emit_device_telemetry(rec)
+            if E.get_bus().active:
+                # span-shaped chunk record (dispatch -> fetch landed):
+                # the flight recorder's rung_compute slice for a resident
+                # serving round, one per chunk like the sweep tier's
+                # sweep_chunk
+                E.emit(
+                    "serve_chunk",
+                    duration_s=round(time.monotonic() - t_dispatch, 6),
+                    family=self.family,
+                    lanes=occupied,
+                    seq=chunk_seq,
+                )
             return out
 
         return fetch

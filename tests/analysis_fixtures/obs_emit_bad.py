@@ -10,6 +10,7 @@ import jax
 from hpbandster_tpu import obs
 from hpbandster_tpu.obs import emit, span
 from hpbandster_tpu.obs.runtime import tracked_jit
+from hpbandster_tpu.obs.timeline import RUNG_COMPUTE, mark, phase_span
 
 
 @jax.jit
@@ -42,5 +43,32 @@ def scorer(v):
     return v
 
 
+@jax.jit
+def staged_rung(x):
+    # timeline span API, resolved import: a phase mark at trace time
+    # stamps ONE rung for the whole compiled program's lifetime
+    mark("rung_started", RUNG_COMPUTE, seq=0)  # BAD
+    return x * 5
+
+
+def rung_body(v):
+    with phase_span("rung_compute", RUNG_COMPUTE):  # BAD
+        return v + 2
+
+
+def _timeline():
+    from hpbandster_tpu.obs import timeline
+
+    return timeline
+
+
+def fetcher(v):
+    # attribute form on an unresolvable receiver: still emission-shaped
+    _timeline().phase_span("telemetry_fetch", "transfer")  # BAD
+    return v
+
+
 loss_fn = jax.jit(loss)
 scorer_fn = jax.vmap(scorer)
+rung_fn = jax.jit(rung_body)
+fetcher_fn = jax.vmap(fetcher)

@@ -4,6 +4,7 @@ import jax
 
 from hpbandster_tpu import obs
 from hpbandster_tpu.obs.runtime import tracked_jit
+from hpbandster_tpu.obs.timeline import RUNG_COMPUTE, TRANSFER, mark, phase_span
 
 
 @jax.jit
@@ -31,6 +32,15 @@ def run_wave(xs):
     with obs.span("wave_evaluate", n=len(xs)):
         out = step(xs)
     obs.emit("job_finished", n=len(xs))
+    return out
+
+
+def run_rung(xs):
+    # timeline flavor of the sanctioned pattern: the HOST wrapper opens
+    # the phase span, the traced body stays pure
+    with phase_span("sweep_chunk", RUNG_COMPUTE, seq=0):
+        out = step(xs)
+    mark("telemetry_fetch", TRANSFER)
     return out
 
 

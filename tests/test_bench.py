@@ -860,8 +860,8 @@ class TestTierSelection:
             "fused10k",
             "chunked10k", "chunked_compile", "fused", "rpc", "batched",
             "teacher", "multitenant", "serve_continuous", "chaos",
-            "async_straggler", "obs_overhead", "runtime_overhead",
-            "collector_overhead", "report_100k",
+            "async_straggler", "obs_overhead", "timeline_overhead",
+            "runtime_overhead", "collector_overhead", "report_100k",
         }
 
 

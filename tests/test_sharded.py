@@ -215,6 +215,43 @@ class TestShardedSampling:
                     np.asarray(x), np.asarray(y), equal_nan=True
                 )
 
+    def test_two_axis_mesh_non_divisible_bracket_bitwise(self):
+        """Regression: a (config, model) mesh with a bracket that does NOT
+        divide the config axis (9 rows over 4 shards) must match the
+        unsharded sweep bitwise. The raw with_sharding_constraint the
+        kernel used to apply here miscompiled under XLA CPU SPMD — every
+        stage index came back scaled by the model-axis size (the
+        __graft_entry__ dryrun crash), so the host-side observation fold
+        indexed out of range."""
+        from jax.sharding import Mesh
+
+        cs = branin_space(seed=0)
+        codec = build_space_codec(cs)
+        plan = BracketPlan((9, 3, 1), (1.0, 3.0, 9.0))
+        plain = make_fused_sweep_fn(
+            branin_from_vector, [plan], codec, min_points_in_model=2**30
+        )
+        mesh2d = Mesh(
+            np.array(jax.devices()).reshape(4, 2), ("config", "model")
+        )
+        sharded = make_fused_sweep_fn(
+            branin_from_vector, [plan], codec, min_points_in_model=2**30,
+            mesh=mesh2d,
+        )
+        o_plain = jax.device_get(plain(np.uint32(3)))[0]
+        o_shard = jax.device_get(sharded(np.uint32(3)))[0]
+        idx = np.asarray(o_shard.idx_packed)
+        assert idx.min() >= 0 and idx.max() < plan.num_configs[0]
+        assert np.array_equal(idx, np.asarray(o_plain.idx_packed))
+        assert np.array_equal(
+            np.asarray(o_shard.loss_packed),
+            np.asarray(o_plain.loss_packed), equal_nan=True,
+        )
+        assert np.array_equal(
+            np.asarray(o_shard.vectors), np.asarray(o_plain.vectors),
+            equal_nan=True,
+        )
+
     def test_incumbent_matches_full_outputs(self):
         cs = branin_space(seed=0)
         codec = build_space_codec(cs)
