@@ -24,6 +24,12 @@ emit into (see docs/observability.md):
 * :mod:`~hpbandster_tpu.obs.anomaly` — streaming anomaly detection
   (stragglers, flapping workers, NaN bursts, KDE-refit stalls,
   recompile storms) emitting ``alert`` events + counters;
+* :mod:`~hpbandster_tpu.obs.slo` / :mod:`~hpbandster_tpu.obs.alerts` —
+  declarative SLOs with multi-window multi-burn-rate evaluation
+  (page 5m/1h, ticket 6h/3d) and the pending → firing → resolved alert
+  lifecycle: journaled ``slo_alert`` transitions,
+  ``slo.<name>.{burn_rate,budget_remaining,state}`` gauges, and a
+  byte-identical offline replay (``obs slo <journal>``);
 * :mod:`~hpbandster_tpu.obs.runtime` — XLA runtime telemetry: the
   :func:`tracked_jit` compile ledger (``xla_compile`` events, per-fn
   recompile counters), the periodic :class:`DeviceSampler` memory /
@@ -75,6 +81,18 @@ from hpbandster_tpu.obs.anomaly import (  # noqa: F401
     AnomalyRules,
     scan_records,
 )
+from hpbandster_tpu.obs.alerts import (  # noqa: F401
+    AlertManager,
+    scan_slo_records,
+)
+from hpbandster_tpu.obs.slo import (  # noqa: F401
+    BurnWindow,
+    DEFAULT_WINDOWS,
+    Selector,
+    SLOEvaluator,
+    SLOSpec,
+    default_slo_pack,
+)
 from hpbandster_tpu.obs.collector import (  # noqa: F401
     FleetCollector,
     derive_fleet,
@@ -121,6 +139,7 @@ from hpbandster_tpu.obs.events import (  # noqa: F401
     RESULT_REPLAYED,
     RPC_CLIENT_CALL,
     RPC_RETRY,
+    SLO_ALERT,
     SWEEP_INCUMBENT,
     UNKNOWN_RESULT,
     WORKER_DISCOVERED,
@@ -220,6 +239,9 @@ __all__ = [
     "current_run", "use_run",
     "HealthEndpoint", "install_crash_dump",
     "AnomalyDetector", "AnomalyRules", "scan_records",
+    "AlertManager", "scan_slo_records", "SLOSpec", "SLOEvaluator",
+    "Selector", "BurnWindow", "DEFAULT_WINDOWS", "default_slo_pack",
+    "SLO_ALERT",
     "AUDIT_EVENTS", "AUDIT_RULE_FIELDS", "config_lineage",
     "emit_bracket_created", "emit_bracket_promotion",
     "emit_config_sampled", "emit_promotion_decision",
@@ -271,12 +293,14 @@ class ObsHandle:
     def __init__(self, detachers: List[Callable[[], None]],
                  journal: Optional[JsonlJournal], ring: Optional[RingBuffer],
                  anomaly: Optional[AnomalyDetector] = None,
-                 sampler: Optional[DeviceSampler] = None):
+                 sampler: Optional[DeviceSampler] = None,
+                 slo: Optional[AlertManager] = None):
         self._detachers = detachers
         self.journal = journal
         self.ring = ring
         self.anomaly = anomaly
         self.sampler = sampler
+        self.slo = slo
 
     def close(self) -> None:
         """Detach every sink and close the journal file (idempotent)."""
@@ -308,6 +332,7 @@ def configure(
     bus: Optional[EventBus] = None,
     anomaly: Union[bool, AnomalyRules, None] = None,
     device_sampler: Union[bool, float, None] = None,
+    slo: Union[bool, List["SLOSpec"], None] = None,
 ) -> ObsHandle:
     """Attach the standard sinks to ``bus`` (default: the process bus).
 
@@ -320,7 +345,11 @@ def configure(
     ``anomaly`` attaches a streaming :class:`AnomalyDetector` (``True``
     for default :class:`AnomalyRules`, or pass tuned rules); its ``alert``
     events land in the same journal and its tally is on the handle as
-    ``handle.anomaly``. ``device_sampler`` starts the periodic per-device
+    ``handle.anomaly``. ``slo`` attaches an :class:`AlertManager`
+    (``True`` for :func:`default_slo_pack`, or pass a list of
+    :class:`SLOSpec`); its ``slo_alert`` transitions land in the same
+    journal (replayable via ``obs slo``) and the manager is on the
+    handle as ``handle.slo``. ``device_sampler`` starts the periodic per-device
     memory / live-buffer gauge sampler (``True`` for the default 10 s
     cadence, or a number of seconds) — only in processes that run device
     work, since the first sample initializes the jax backend. Returns an
@@ -351,9 +380,16 @@ def configure(
             bus=bus,
         )
         detachers.append(bus.subscribe(detector))
+    manager = None
+    if slo:
+        manager = AlertManager(
+            specs=slo if isinstance(slo, (list, tuple)) else None,
+            bus=bus,
+        )
+        detachers.append(bus.subscribe(manager))
     sampler = None
     if device_sampler:
         sampler = start_device_sampler(
             interval_s=10.0 if device_sampler is True else float(device_sampler)
         )
-    return ObsHandle(detachers, journal, ring, detector, sampler)
+    return ObsHandle(detachers, journal, ring, detector, sampler, manager)

@@ -27,6 +27,20 @@
   threshold) — the same verdict ``bench.py``'s ``timeline_overhead``
   tier records next to the budget verdicts. Exit 0 even when the
   verdict fails (it reports, the bench gate enforces).
+* ``slo <journal> [<journal> ...] [--json]`` — deterministic offline
+  re-evaluation of the SLO pack (``obs/slo.py`` + ``obs/alerts.py``)
+  over a journaled run: per-SLO burn rate / budget-remaining / state
+  table, the alert-transition replay-parity check (journaled
+  ``slo_alert`` records, envelope stripped, must match the offline
+  recomputation byte-identically), and a machine-readable verdict
+  ``{firing, budget_remaining, ok}`` — the same verdict ``bench.py``'s
+  ``slo_overhead`` tier records. Exit 0 even when the verdict fails
+  (it reports, the bench gate enforces).
+* ``alerts <journal> [<journal> ...] [--json]`` — the alert lifecycle
+  ledger: every ``slo_alert`` transition (pending -> firing ->
+  resolved) with its burn rates and budget, from the journal's own
+  records when the run was live-managed or from an offline scan
+  otherwise.
 * ``watch <journal> [--interval S] [--ticks N]`` — tail a live journal,
   one status line per tick; runs until ^C unless ``--ticks`` bounds it.
   ``watch --snapshot <uri> [--snapshot <uri> ...]`` polls live
@@ -157,6 +171,142 @@ def run_export(
         pass
     finally:
         server.close()
+    return 0
+
+
+# the payload half of a slo_alert record — everything the evaluator
+# computed, nothing the bus envelope stamped (t_wall/host/pid differ
+# between the live emit and the offline recomputation by design)
+_SLO_PAYLOAD = (
+    "slo", "severity", "state", "burn_short", "burn_long",
+    "budget_remaining", "key",
+)
+
+_STATE_NAMES = {0: "ok", 1: "pending", 2: "firing"}
+
+
+def _slo_payload(rec: dict) -> dict:
+    return {k: rec.get(k) for k in _SLO_PAYLOAD}
+
+
+def run_slo(
+    journals: List[str],
+    as_json: bool = False,
+    stream: Optional[Any] = None,
+) -> int:
+    """The ``slo`` subcommand body (separated so tests drive it):
+    re-evaluate the SLO pack offline over journal records, check the
+    journaled ``slo_alert`` stream against the recomputation, and print
+    the per-SLO table + machine-readable verdict."""
+    from hpbandster_tpu.obs.alerts import scan_slo_records
+
+    out = stream if stream is not None else sys.stdout
+    records = _read_checked(journals)
+    if records is None:
+        return 2
+    mgr = scan_slo_records(records)
+    snap = mgr.snapshot()
+    recomputed = [_slo_payload(t) for t in mgr.transitions]
+    recorded = [
+        _slo_payload(r) for r in records if r.get("event") == "slo_alert"
+    ]
+    replay = {
+        "recorded_transitions": len(recorded),
+        "recomputed_transitions": len(recomputed),
+        # the byte-identical contract: a live-managed run's journaled
+        # slo_alert records, envelope stripped, equal the offline
+        # recomputation exactly; None = run had no live manager, so
+        # there is nothing to compare (not a failure)
+        "identical": (recorded == recomputed) if recorded else None,
+    }
+    budgets = [
+        p["budget_remaining"]
+        for p in snap["by_slo"].values()
+        if p.get("budget_remaining") is not None
+    ]
+    worst_budget = min(budgets) if budgets else None
+    verdict = {
+        "firing": snap["firing"],
+        "budget_remaining": worst_budget,
+        "ok": bool(
+            snap["firing"] == 0
+            and (worst_budget is None or worst_budget > 0.0)
+            and replay["identical"] is not False
+        ),
+    }
+    doc = {"slo": snap, "replay": replay, "verdict": verdict}
+    if as_json:
+        print(json.dumps(doc, indent=1, sort_keys=True), file=out)
+        return 0
+    status = "OK" if verdict["ok"] else "FAIL"
+    print(
+        f"slo verdict: {status} — {snap['firing']} firing, worst burn "
+        f"{snap['worst_burn_rate']}, worst budget {worst_budget}",
+        file=out,
+    )
+    if not snap["by_slo"]:
+        print("  (no SLO-relevant records in this journal)", file=out)
+    for name, pub in snap["by_slo"].items():
+        state = _STATE_NAMES.get(pub["state"], str(pub["state"]))
+        print(
+            f"  {name:<24} burn={pub['burn_rate']}  "
+            f"budget={pub['budget_remaining']}  state={state}",
+            file=out,
+        )
+    ident = replay["identical"]
+    tag = ("n/a (no journaled slo_alert records)" if ident is None
+           else "identical" if ident else "MISMATCH")
+    print(
+        f"  replay parity: {tag} "
+        f"({replay['recorded_transitions']} recorded / "
+        f"{replay['recomputed_transitions']} recomputed)",
+        file=out,
+    )
+    return 0
+
+
+def run_alerts(
+    journals: List[str],
+    as_json: bool = False,
+    stream: Optional[Any] = None,
+) -> int:
+    """The ``alerts`` subcommand body (separated so tests drive it):
+    list every slo_alert lifecycle transition — the journal's own
+    records when the run was live-managed, an offline scan otherwise."""
+    from hpbandster_tpu.obs.alerts import scan_slo_records
+
+    out = stream if stream is not None else sys.stdout
+    records = _read_checked(journals)
+    if records is None:
+        return 2
+    recorded = [r for r in records if r.get("event") == "slo_alert"]
+    if recorded:
+        source, raw = "journal", recorded
+    else:
+        source, raw = "offline_scan", list(scan_slo_records(records).transitions)
+    times = [
+        r.get("t_wall") for r in records
+        if isinstance(r.get("t_wall"), (int, float))
+    ]
+    t0 = min(times) if times else 0.0
+    rows = []
+    for r in raw:
+        t = r.get("t_wall")
+        at_s = round(float(t) - t0, 3) if isinstance(t, (int, float)) else None
+        rows.append({"at_s": at_s, **_slo_payload(r)})
+    doc = {"source": source, "count": len(rows), "transitions": rows}
+    if as_json:
+        print(json.dumps(doc, indent=1, sort_keys=True), file=out)
+        return 0
+    print(f"slo alert transitions ({source}): {len(rows)}", file=out)
+    for r in rows:
+        at = f"+{r['at_s']:.3f}s" if r["at_s"] is not None else "?"
+        print(
+            f"  {at:>12}  {str(r['slo']):<24} {str(r['severity']):<7} "
+            f"-> {str(r['state']):<9} burn {r['burn_short']}/{r['burn_long']} "
+            f"budget {r['budget_remaining']}",
+            file=out,
+        )
     return 0
 
 
@@ -345,6 +495,33 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--json", action="store_true", dest="as_json",
         help="emit the replay report as JSON instead of text",
     )
+    p_slo = sub.add_parser(
+        "slo",
+        help="re-evaluate the SLO pack over a journaled run: per-SLO "
+        "burn/budget/state table, replay-parity check, machine-readable "
+        "verdict (see docs/observability.md 'SLOs & alerting')",
+    )
+    p_slo.add_argument(
+        "journals", nargs="+", metavar="journal",
+        help="JSONL run journal(s) — merged before evaluation",
+    )
+    p_slo.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the table, replay parity, and verdict as JSON",
+    )
+    p_al = sub.add_parser(
+        "alerts",
+        help="list every slo_alert lifecycle transition (pending -> "
+        "firing -> resolved) with burn rates and budget",
+    )
+    p_al.add_argument(
+        "journals", nargs="+", metavar="journal",
+        help="JSONL run journal(s) — merged before evaluation",
+    )
+    p_al.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the transition list as JSON",
+    )
     p_watch = sub.add_parser(
         "watch", help="tail a live journal (or poll a health RPC), "
         "one status line per tick"
@@ -433,6 +610,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             uris=args.snapshot, series=args.series, interval=args.interval,
             ticks=args.ticks, clear=not args.no_clear, tenant=args.tenant,
         )
+
+    if args.command == "slo":
+        return run_slo(args.journals, as_json=args.as_json)
+
+    if args.command == "alerts":
+        return run_alerts(args.journals, as_json=args.as_json)
 
     if args.command == "export":
         return run_export(

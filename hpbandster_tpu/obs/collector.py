@@ -180,6 +180,36 @@ def lane_gauges(gauges: Mapping[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def slo_gauges(gauges: Mapping[str, Any]) -> Dict[str, Any]:
+    """``{worst_burn_rate, firing, slos}`` from the SLO gauge plane
+    (``obs/alerts.py`` publishes ``slo.<name>.{burn_rate,
+    budget_remaining,state}``): worst burn rate across specs, count of
+    specs currently firing (state >= 2 per ``alerts.STATE_CODES``), and
+    the spec census. THE one parser of these names — the ``top`` fleet
+    SLO line, ``watch --snapshot``'s slo column, and the endpoint row
+    all read through it. Empty dict when the endpoint runs no
+    AlertManager, so SLO-free fleets render exactly as before."""
+    worst: Optional[float] = None
+    firing = 0
+    slos = 0
+    for name, value in (gauges or {}).items():
+        if not isinstance(name, str) or not name.startswith("slo."):
+            continue
+        v = _num(value)
+        if v is None:
+            continue
+        if name.endswith(".state"):
+            slos += 1
+            if v >= 2:
+                firing += 1
+        elif name.endswith(".burn_rate"):
+            if worst is None or v > worst:
+                worst = v
+    if slos == 0 and worst is None:
+        return {}
+    return {"worst_burn_rate": worst, "firing": firing, "slos": slos}
+
+
 def _endpoint_row(snap: Dict[str, Any]) -> Dict[str, Any]:
     """Distill one ``obs_snapshot`` into the per-endpoint series row: the
     handful of fields fleet aggregation and ``top`` actually read."""
@@ -227,6 +257,9 @@ def _endpoint_row(snap: Dict[str, Any]) -> Dict[str, Any]:
     # starved lanes and program-warm age — the `top` lane line and the
     # watch lanes part (ONE parser, lane_gauges)
     lanes = lane_gauges(gauges)
+    # SLO plane (obs/alerts.py): worst burn rate + firing count per
+    # endpoint — what the fleet verdict rolls up (ONE parser, slo_gauges)
+    slo = slo_gauges(gauges)
     return {
         "component": snap.get("component"),
         "uptime_s": _num(snap.get("uptime_s")),
@@ -242,6 +275,7 @@ def _endpoint_row(snap: Dict[str, Any]) -> Dict[str, Any]:
         "sweep_devices": sweep_devices,
         "device_metrics": device_metrics,
         "lanes": lanes,
+        "slo": slo,
         "alerts_total": _num(alerts.get("total")),
         "tenants": tenants,
     }
@@ -378,6 +412,20 @@ def derive_fleet(
             if any(v > 0 for v in tenant_done.values()) else 0
         )
 
+    # fleet SLO verdict: worst burn rate across every endpoint's specs,
+    # total firing count — one number pair that says whether the fleet
+    # is inside its objectives (None when no endpoint runs SLOs)
+    slo_worst: Optional[float] = None
+    slo_firing: Optional[float] = None
+    for r in rows.values():
+        s = r.get("slo") or {}
+        w = _num(s.get("worst_burn_rate"))
+        if w is not None and (slo_worst is None or w > slo_worst):
+            slo_worst = w
+        f = _num(s.get("firing"))
+        if f is not None:
+            slo_firing = (slo_firing or 0.0) + f
+
     return {
         "endpoints": len(rows),
         "ok": ok,
@@ -398,6 +446,10 @@ def derive_fleet(
         "tenants": len(tenant_done) if tenant_done else None,
         "tenants_starved": starved,
         "tenant_throughput_ratio": ratio,
+        "slo_worst_burn_rate": (
+            round(slo_worst, 4) if slo_worst is not None else None
+        ),
+        "slo_firing": int(slo_firing) if slo_firing is not None else None,
     }
 
 
@@ -697,6 +749,8 @@ class FleetCollector:
             ("tenants", "fleet.tenants"),
             ("tenants_starved", "fleet.tenants_starved"),
             ("tenant_throughput_ratio", "fleet.tenant_throughput_ratio"),
+            ("slo_worst_burn_rate", "fleet.slo_worst_burn_rate"),
+            ("slo_firing", "fleet.slo_firing"),
         ):
             v = _num(fleet.get(field))
             if v is not None:
@@ -953,6 +1007,19 @@ def format_fleet_table(
             .format(
                 occupied, total, starved,
                 _fmt(max(ages), 1) if ages else "-",
+            )
+        )
+    # SLO verdict line (obs/alerts.py gauges via slo_gauges): present
+    # only when an endpoint runs an AlertManager, so SLO-free fleets
+    # render exactly as before
+    if (
+        fleet.get("slo_worst_burn_rate") is not None
+        or fleet.get("slo_firing") is not None
+    ):
+        lines.append(
+            "       slo: worst_burn={}  firing={}".format(
+                _fmt(fleet.get("slo_worst_burn_rate"), 2),
+                _fmt(fleet.get("slo_firing")),
             )
         )
     lines.append("")

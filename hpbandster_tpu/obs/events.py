@@ -69,6 +69,7 @@ __all__ = [
     "LANE_ASSIGNED",
     "LANE_RELEASED",
     "RPC_CLIENT_CALL",
+    "SLO_ALERT",
 ]
 
 logger = logging.getLogger("hpbandster_tpu.obs")
@@ -143,6 +144,12 @@ LANE_RELEASED = "lane_released"
 #: when a sink listens, so the no-recorder RPC path pays one
 #: ``bus.active`` read and nothing else
 RPC_CLIENT_CALL = "rpc_client_call"
+#: one SLO alert lifecycle transition (obs/alerts.py AlertManager):
+#: pending -> firing -> resolved, each journaled with the burn rates and
+#: budget remaining that justified it — timestamps derive from the
+#: records that drove the evaluator, so an offline replay of the same
+#: journal reproduces the transitions byte-identically
+SLO_ALERT = "slo_alert"
 
 #: the core vocabulary (docs/observability.md "Event schema"). emit() also
 #: accepts names outside this set — subsystems may add their own (span
@@ -154,7 +161,7 @@ EVENT_TYPES = frozenset({
     CONFIG_SAMPLED, PROMOTION_DECISION, ALERT, XLA_COMPILE, FLEET_SAMPLE,
     JOB_REQUEUED, RESULT_REPLAYED, DUPLICATE_RESULT, WORKER_QUARANTINED,
     CHAOS_FAULT, SWEEP_INCUMBENT, DEVICE_TELEMETRY, LANE_ASSIGNED,
-    LANE_RELEASED, RPC_CLIENT_CALL,
+    LANE_RELEASED, RPC_CLIENT_CALL, SLO_ALERT,
 })
 
 #: process-wide kill switch (hpbandster_tpu.obs.set_enabled)

@@ -143,6 +143,22 @@ _LABEL_RULES: Tuple[Tuple[re.Pattern, str, object], ...] = (
         r"^serve\.tenant\.(?P<label>.+)\.(?P<field>[a-zA-Z0-9_]+)$",
         re.DOTALL),
      "serve_tenant_{field}", "tenant"),
+    # SLO gauges (obs/alerts.py AlertManager): slo.<name>.burn_rate ->
+    # slo_burn_rate{slo="<name>"} — one labeled family per field so a
+    # scraper alerts on max(slo_burn_rate) across specs. Greedy label +
+    # dot-free field (the serve-tenant idiom): a spec name containing
+    # dots keeps them in the label, the LAST dot separates the field.
+    (re.compile(
+        r"^slo\.(?P<label>.+)\.(?P<field>[a-zA-Z0-9_]+)$",
+        re.DOTALL),
+     "slo_{field}", "slo"),
+    # alert lifecycle counters (obs/alerts.py): alert.transitions.<slo>
+    # -> slo_alert_transitions{slo="<slo>"} — a DISTINCT family from the
+    # flattened global alert.transitions / alert.firing totals (the
+    # anomaly_rule_alerts idiom: per-entity and global tallies must not
+    # share one exposition family).
+    (re.compile(r"^alert\.transitions\.(?P<label>.+)$", re.DOTALL),
+     "slo_alert_transitions", "slo"),
 )
 
 

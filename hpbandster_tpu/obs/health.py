@@ -70,6 +70,7 @@ class HealthEndpoint:
         in_flight: Optional[Callable[[], Any]] = None,
         registry: Optional[MetricsRegistry] = None,
         anomaly: Optional[Any] = None,
+        slo: Optional[Any] = None,
     ):
         self.component = component
         self.identity = dict(identity) if identity is not None else process_identity()
@@ -79,6 +80,9 @@ class HealthEndpoint:
         #: optional obs.anomaly.AnomalyDetector whose alert tally rides
         #: the snapshot (anything with a .snapshot() -> dict works)
         self._anomaly = anomaly
+        #: optional obs.alerts.AlertManager whose SLO verdict rides the
+        #: snapshot (same duck-typed .snapshot() contract as anomaly)
+        self._slo = slo
         self._t0_mono = time.monotonic()
         self._t0_wall = time.time()
 
@@ -118,6 +122,11 @@ class HealthEndpoint:
                 out["alerts"] = self._anomaly.snapshot()
             except Exception:
                 logger.exception("obs_snapshot anomaly snapshot failed")
+        if self._slo is not None:
+            try:
+                out["slo"] = self._slo.snapshot()
+            except Exception:
+                logger.exception("obs_snapshot slo snapshot failed")
         return out
 
     def _runtime_section(self) -> Dict[str, Any]:

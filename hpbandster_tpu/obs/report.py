@@ -45,6 +45,7 @@ import json
 from typing import Any, Dict, List, Optional, Tuple
 
 from hpbandster_tpu.obs import events as E
+from hpbandster_tpu.obs.alerts import scan_slo_records
 from hpbandster_tpu.obs.anomaly import scan_records
 from hpbandster_tpu.obs.audit import config_key, config_lineage
 from hpbandster_tpu.obs.device_metrics import (
@@ -427,6 +428,49 @@ def _alert_digest(records: List[Dict[str, Any]], t0: Optional[float]) -> Dict[st
     }
 
 
+def _slo_digest(
+    records: List[Dict[str, Any]], t0: Optional[float]
+) -> Dict[str, Any]:
+    """The SLO story of a journal: the re-evaluated burn-rate verdict
+    (scan_slo_records is deterministic, so two reports of one journal
+    agree) plus the lifecycle transitions — journaled ``slo_alert``
+    records when the run carried a live AlertManager, the offline scan's
+    otherwise (the _alert_digest source convention)."""
+    recorded = [r for r in records if r.get("event") == E.SLO_ALERT]
+    mgr = scan_slo_records(records)
+    source = "journal"
+    transitions = recorded
+    if not recorded:
+        transitions = list(mgr.transitions)
+        source = "offline_scan"
+    snap = mgr.snapshot()
+    rows = [
+        {
+            "at_s": (
+                round(tr["t_wall"] - t0, 3)
+                if t0 is not None and isinstance(
+                    tr.get("t_wall"), (int, float)
+                ) else None
+            ),
+            "slo": tr.get("slo"),
+            "severity": tr.get("severity"),
+            "state": tr.get("state"),
+            "burn_short": tr.get("burn_short"),
+            "burn_long": tr.get("burn_long"),
+            "budget_remaining": tr.get("budget_remaining"),
+        }
+        for tr in transitions
+    ]
+    return {
+        "source": source,
+        "transitions": len(rows),
+        "firing": snap["firing"],
+        "worst_burn_rate": snap["worst_burn_rate"],
+        "by_slo": snap["by_slo"],
+        "rows": rows,
+    }
+
+
 # -------------------------------------------------------------------- report
 def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Aggregate merged journal records into the report dict."""
@@ -460,6 +504,7 @@ def build_report(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         # aggregation with summarize, same drift rule as runtime
         "device": device_section_from_records(records),
         "alerts": _alert_digest(records, t0),
+        "slo": _slo_digest(records, t0),
     }
 
 
@@ -595,5 +640,32 @@ def format_report(rep: Dict[str, Any]) -> str:
         )
     if al["total"] > 20:
         lines.append(f"  ... {al['total'] - 20} more (use --json for all)")
+
+    slo = rep.get("slo") or {}
+    if slo.get("by_slo"):
+        lines += [
+            "",
+            "slo verdict ({}): {} firing, worst burn {}".format(
+                slo["source"], slo["firing"],
+                _fmt(slo["worst_burn_rate"]),
+            ),
+        ]
+        for name, row in slo["by_slo"].items():
+            lines.append(
+                f"  {name}: burn={_fmt(row.get('burn_rate'))} "
+                f"budget_remaining={_fmt(row.get('budget_remaining'))} "
+                f"state={row.get('state')}"
+            )
+        for tr in slo["rows"][:10]:
+            lines.append(
+                f"  t+{_fmt(tr['at_s'])}s {tr['slo']}[{tr['severity']}] "
+                f"-> {tr['state']} (burn {_fmt(tr['burn_short'])}/"
+                f"{_fmt(tr['burn_long'])})"
+            )
+        if slo["transitions"] > 10:
+            lines.append(
+                f"  ... {slo['transitions'] - 10} more transitions "
+                "(use --json for all)"
+            )
     lines.append("")
     return "\n".join(lines)

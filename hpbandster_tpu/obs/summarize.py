@@ -702,6 +702,23 @@ def _snapshot_lane_part(snap: Dict[str, Any]) -> str:
     return (" lanes: " + " ".join(parts)) if parts else ""
 
 
+def _snapshot_slo_part(snap: Dict[str, Any]) -> str:
+    """The SLO slice of one watch line: worst burn rate + firing count
+    (``obs/alerts.py`` gauges, read through the collector's one parser,
+    ``slo_gauges``). No SLOs, no part — lines from manager-free
+    processes stay exactly as they were."""
+    from hpbandster_tpu.obs.collector import slo_gauges
+
+    slo = slo_gauges((snap.get("metrics") or {}).get("gauges"))
+    if not slo:
+        return ""
+    worst = slo.get("worst_burn_rate")
+    return " slo: worst_burn={} firing={}".format(
+        f"{worst:.2f}" if isinstance(worst, (int, float)) else "-",
+        int(slo.get("firing", 0)),
+    )
+
+
 def _snapshot_device_part(snap: Dict[str, Any]) -> str:
     """The device-metrics-plane slice of one watch line: the last
     sweep's decoded in-trace counters (``sweep.device_metrics.*``
@@ -747,6 +764,7 @@ def _snapshot_status_line(
         + (f" latency: {lat_part}" if lat_part else "")
         + _snapshot_tenant_part(snap, tenant)
         + _snapshot_lane_part(snap)
+        + _snapshot_slo_part(snap)
         + _snapshot_device_part(snap)
         + _snapshot_runtime_part(snap)
     )

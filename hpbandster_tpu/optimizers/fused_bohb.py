@@ -54,6 +54,21 @@ __all__ = ["FusedBOHB", "FusedHyperBand", "FusedRandomSearch", "FusedH2BO"]
 _SWEEP_EXE_CACHE: LRUCache = LRUCache(maxsize=16)
 
 
+def _note_device_refits(decoded: Dict[str, Any]) -> None:
+    """Surface device-side TPE fits to the event plane: a fused sweep
+    fits its models in-trace, so the host-side ``kde_refit`` emit in
+    models/bohb_kde.py never fires and the model-freshness consumers
+    (the kde_refit_stall anomaly rule, the kde_refit_staleness SLO in
+    obs/slo.py) would read a healthy fused run as permanently stale.
+    One event per telemetry fold that recorded any fits."""
+    fits = decoded.get("model_fits")
+    if (
+        isinstance(fits, (int, float)) and fits > 0
+        and obs.get_bus().active
+    ):
+        obs.emit(obs.KDE_REFIT, source="device", fits=int(fits))
+
+
 class _ReplayIteration(SuccessiveHalving):
     """SuccessiveHalving whose promotion decisions replay the device's.
 
@@ -934,6 +949,7 @@ class FusedBOHB:
             # chunk spans (summarize trace_timelines / obs timeline)
             with use_trace(sweep_trace):
                 emit_device_telemetry(decoded)
+                _note_device_refits(decoded)
             self.last_device_telemetry = decoded
         self._write_timings_sidecar()
         return Result(
@@ -1108,6 +1124,7 @@ class FusedBOHB:
             publish_device_metrics(decoded)
             with use_trace(inc_trace):
                 emit_device_telemetry(decoded)
+                _note_device_refits(decoded)
             self.last_device_telemetry = decoded
             out["device_telemetry"] = decoded
         return out

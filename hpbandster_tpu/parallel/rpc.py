@@ -213,8 +213,12 @@ class RPCProxy:
         try:
             # the flight-recorder hop span (obs/timeline.py renders it as
             # the RPC-phase slice of a trace's row): span() is near-free
-            # when no sink listens — no clock reads, no event
-            with obs_events.span(obs_events.RPC_CLIENT_CALL, method=method):
+            # when no sink listens — no clock reads, no event. peer rides
+            # the record so the rpc_retry_rate SLO's journal evidence can
+            # be cut per endpoint post-hoc.
+            with obs_events.span(
+                obs_events.RPC_CLIENT_CALL, method=method, peer=self.uri
+            ):
                 with socket.create_connection(
                     self.addr, timeout=self.timeout
                 ) as sock:

@@ -485,6 +485,7 @@ class ContinuousRunner:
             m.gauge(f"serve.family.{self.family}.lanes_starved").set(
                 self._last_starved
             )
+            starved = self._last_starved
             chunk_seq = self.chunks_run - 1
             t_dispatch = time.monotonic()
 
@@ -535,6 +536,10 @@ class ContinuousRunner:
                     duration_s=round(time.monotonic() - t_dispatch, 6),
                     family=self.family,
                     lanes=occupied,
+                    # the lane_starvation SLO reads this off the chunk
+                    # record (good when 0) — the gauge above is the live
+                    # twin, this is the journaled/replayable one
+                    starved=starved,
                     seq=chunk_seq,
                 )
             return out

@@ -46,6 +46,7 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from hpbandster_tpu import obs
+from hpbandster_tpu.obs import events as obs_events
 from hpbandster_tpu.serve.session import (
     SweepSpec,
     TenantMaster,
@@ -168,6 +169,19 @@ class ServeFrontend:
             return f"authentication failed for tenant {tenant!r}"
         return None
 
+    def _note_auth(self, tenant: str, ok: bool) -> None:
+        """Record one authentication outcome: the reject counter (the
+        authn metric operators watch) plus a ``tenant_auth`` event —
+        BOTH outcomes, because the auth-reject SLO (obs/slo.py default
+        pack) is a ratio and needs the accepted calls as its total."""
+        if not ok:
+            obs.get_metrics().counter(
+                f"serve.tenant.{tenant}.auth_rejected"
+            ).inc()
+        bus = obs_events.get_bus()
+        if bus.active:
+            bus.emit("tenant_auth", tenant=tenant, ok=ok)
+
     # ------------------------------------------------------------- RPC body
     def submit_sweep(
         self, tenant: str, spec: Optional[Dict[str, Any]] = None,
@@ -176,10 +190,8 @@ class ServeFrontend:
         if not isinstance(tenant, str) or not tenant:
             return {"accepted": False, "reason": "tenant must be a non-empty string"}
         denied = self._authenticate(tenant, token)
+        self._note_auth(tenant, denied is None)
         if denied is not None:
-            obs.get_metrics().counter(
-                f"serve.tenant.{tenant}.auth_rejected"
-            ).inc()
             return {"accepted": False, "reason": denied}
         try:
             sweep_spec = SweepSpec.from_dict(spec or {})
@@ -334,13 +346,10 @@ class ServeFrontend:
         self, tenant: str, sweep_id: str, token: Optional[str] = None
     ) -> Dict[str, Any]:
         denied = self._authenticate(tenant, token)
+        # counted like submit rejects: status/result probes are the
+        # cheap brute-force surface
+        self._note_auth(tenant, denied is None)
         if denied is not None:
-            # counted like submit rejects: status/result probes are the
-            # cheap brute-force surface, and the counter is the one
-            # authn metric operators watch
-            obs.get_metrics().counter(
-                f"serve.tenant.{tenant}.auth_rejected"
-            ).inc()
             return {"error": denied}
         run = self._run_for(tenant, sweep_id)
         if run is None:
@@ -360,10 +369,8 @@ class ServeFrontend:
         self, tenant: str, sweep_id: str, token: Optional[str] = None
     ) -> Dict[str, Any]:
         denied = self._authenticate(tenant, token)
+        self._note_auth(tenant, denied is None)
         if denied is not None:
-            obs.get_metrics().counter(
-                f"serve.tenant.{tenant}.auth_rejected"
-            ).inc()
             return {"error": denied}
         run = self._run_for(tenant, sweep_id)
         if run is None:

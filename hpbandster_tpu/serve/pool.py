@@ -46,6 +46,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from hpbandster_tpu import obs
+from hpbandster_tpu.obs import events as obs_events
 from hpbandster_tpu.core.job import Job
 from hpbandster_tpu.serve.megabatch import PackEntry, make_mega_runner
 from hpbandster_tpu.serve.scheduler import (
@@ -542,12 +543,21 @@ class ServePool:
                 sum(len(qq) for qq in self._queues.values())
             )
         wait_now = time.monotonic()
+        bus_active = obs_events.get_bus().active
         for tenant, item in selected:
             wait_s = max(wait_now - item.enqueue_mono, 0.0)
             m.histogram("serve.queue_wait_s").observe(wait_s)
             m.histogram(f"serve.tenant.{tenant}.queue_wait_s").observe(
                 wait_s
             )
+            if bus_active:
+                # the serve_admission SLO's unit of work (obs/slo.py
+                # default pack): one record per admitted item, judged
+                # good when wait_s clears the latency target
+                obs_events.emit(
+                    "serve_admission",
+                    wait_s=round(wait_s, 6), tenant=tenant,
+                )
         try:
             self._run_items([item for _, item in selected])
         finally:

@@ -46,12 +46,16 @@ def test_repo_tree_is_clean():
 
 def test_obs_tree_is_scanned_and_clean():
     """The obs subsystem is inside the gate's scan paths (no new package
-    may silently fall outside the walk) and graftlint-clean on its own."""
+    may silently fall outside the walk) and graftlint-clean on its own.
+    ISSUE 20 pins the SLO layer explicitly: slo.py and alerts.py must be
+    in the walk, not just whatever the glob happens to pick up."""
     from hpbandster_tpu.analysis import collect_files
 
     scanned = set(collect_files(SCAN))
     obs_files = {str(p) for p in OBS_TREE.glob("*.py")}
     assert obs_files, "hpbandster_tpu/obs has no python files?"
+    assert str(OBS_TREE / "slo.py") in obs_files
+    assert str(OBS_TREE / "alerts.py") in obs_files
     assert obs_files <= scanned, sorted(obs_files - scanned)
     findings = run([str(OBS_TREE)])
     assert findings == [], "\n" + format_report(findings)

@@ -358,6 +358,40 @@ class TestEnsembleTier:
             bench.BUDGET_VERDICTS.pop("ensemble_smoke", None)
 
 
+class TestSloOverheadTier:
+    """ISSUE 20 acceptance: the ``slo_overhead`` tier runs END TO END —
+    a live AlertManager riding a real journaled ServePool churn — and
+    lands under the <2% obs bar with the offline replay byte-identical
+    and the machine-readable verdict riding the tier dict."""
+
+    def test_slo_tier_runs_budget_gated_under_two_pct(self):
+        errors = {}
+        out = bench._run_tier(
+            errors, "slo_overhead", bench.bench_slo_overhead,
+            micro_records=2_000, n_tenants=2,
+        )
+        try:
+            assert errors == {}, errors
+            assert out is not None
+            # the CI gate: evaluator cost projected onto the churn wall
+            assert out["overhead_pct"] < 2.0, out
+            assert out["process_ns"] > 0
+            assert out["specs"] == 6  # the default pack
+            # live == offline, byte-identical (the obs slo contract)
+            assert out["replay"]["identical"] is True
+            v = out["verdict"]
+            assert set(v) == {"firing", "budget_remaining", "ok",
+                              "replay_identical"}
+            assert v["replay_identical"] is True
+            # budget gate judged the tier and passed: pure host math,
+            # no device work beyond the serve pool's own programs
+            bv = bench.BUDGET_VERDICTS["slo_overhead"]
+            assert bv["ok"], bv
+        finally:
+            bench.COMPILE_BY_TIER.pop("slo_overhead", None)
+            bench.BUDGET_VERDICTS.pop("slo_overhead", None)
+
+
 class TestServeContinuousTier:
     """ISSUE 15 acceptance: the ``serve_continuous`` tier runs END TO END
     (small lane count, 8-device CPU mesh conftest), budget-gated, with
@@ -638,6 +672,14 @@ def _stub_tiers(monkeypatch, calls):
         and {"overhead_pct": 0.6, "poll_round_s": 0.012, "n_endpoints": 3,
              "interval_s": 2.0, "duty_cycle_pct": 0.6})
     monkeypatch.setattr(
+        bench, "bench_slo_overhead",
+        lambda **kw: calls.setdefault("slo_overhead", True)
+        and {"overhead_pct": 0.14, "process_ns": 20000.0, "specs": 6,
+             "slo_records_per_churn": 120, "warm_churn_s": 1.2,
+             "replay": {"live_transitions": 2, "identical": True},
+             "verdict": {"firing": 0, "budget_remaining": 0.9,
+                         "ok": True, "replay_identical": True}})
+    monkeypatch.setattr(
         bench, "bench_report_100k",
         lambda **kw: calls.setdefault("report_100k", True)
         and {"n_events": 100000, "events_per_s": 1, "deterministic": True})
@@ -861,7 +903,8 @@ class TestTierSelection:
             "chunked10k", "chunked_compile", "fused", "rpc", "batched",
             "teacher", "multitenant", "serve_continuous", "chaos",
             "async_straggler", "obs_overhead", "timeline_overhead",
-            "runtime_overhead", "collector_overhead", "report_100k",
+            "runtime_overhead", "collector_overhead", "slo_overhead",
+            "report_100k",
         }
 
 

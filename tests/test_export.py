@@ -353,6 +353,91 @@ class TestRooflineFamilies:
             ({}, 0.0)
         ]
 
+    def test_slo_families_are_labeled(self):
+        """ISSUE 20 satellite: the SLO gauge plane exports as
+        slo-labeled families; the alert globals stay unlabeled."""
+        fam, labels = metric_family("slo.serve_admission.burn_rate")
+        assert fam == "hpbandster_slo_burn_rate"
+        assert labels == {"slo": "serve_admission"}
+        fam, labels = metric_family("slo.serve_admission.budget_remaining")
+        assert fam == "hpbandster_slo_budget_remaining"
+        assert labels == {"slo": "serve_admission"}
+        fam, labels = metric_family("slo.kde_refit_staleness.state")
+        assert fam == "hpbandster_slo_state"
+        assert labels == {"slo": "kde_refit_staleness"}
+        # dotted spec names keep their dots inside the label (the LAST
+        # dot separates the field)
+        fam, labels = metric_family("slo.serve.v2.burn_rate")
+        assert fam == "hpbandster_slo_burn_rate"
+        assert labels == {"slo": "serve.v2"}
+        # per-slo transition counters: their own family, NOT the global
+        # alert.transitions tally's (mixed labeled/unlabeled families
+        # are malformed expositions)
+        fam, labels = metric_family("alert.transitions.serve_admission")
+        assert fam == "hpbandster_slo_alert_transitions"
+        assert labels == {"slo": "serve_admission"}
+        fam, labels = metric_family("alert.transitions")
+        assert fam == "hpbandster_alert_transitions"
+        assert labels == {}
+        fam, labels = metric_family("alert.firing")
+        assert fam == "hpbandster_alert_firing"
+        assert labels == {}
+
+    def test_slo_round_trip(self):
+        reg = obs.MetricsRegistry()
+        reg.gauge("slo.serve_admission.burn_rate").set(14.4)
+        reg.gauge("slo.serve_admission.budget_remaining").set(-0.25)
+        reg.gauge("slo.serve_admission.state").set(2.0)
+        reg.gauge("slo.rpc_retry_rate.burn_rate").set(0.5)
+        reg.gauge("alert.firing").set(1.0)
+        reg.counter("alert.transitions").inc(3)
+        reg.counter("alert.transitions.serve_admission").inc(3)
+        families = parse_prometheus_text(render_registry(reg))
+        burn = families["hpbandster_slo_burn_rate"]
+        assert burn["type"] == "gauge"
+        assert {l["slo"]: v for l, v in burn["samples"]} == {
+            "serve_admission": 14.4, "rpc_retry_rate": 0.5,
+        }
+        assert families["hpbandster_slo_budget_remaining"]["samples"] == [
+            ({"slo": "serve_admission"}, -0.25)
+        ]
+        assert families["hpbandster_slo_state"]["samples"] == [
+            ({"slo": "serve_admission"}, 2.0)
+        ]
+        trans = families["hpbandster_slo_alert_transitions_total"]
+        assert trans["type"] == "counter"
+        assert trans["samples"] == [({"slo": "serve_admission"}, 3.0)]
+        assert families["hpbandster_alert_transitions_total"]["samples"] == [
+            ({}, 3.0)
+        ]
+        assert families["hpbandster_alert_firing"]["samples"] == [({}, 1.0)]
+
+    def test_live_alert_manager_to_scrape_end_to_end(self):
+        """A firing AlertManager's gauges reach a scraper with no extra
+        wiring (bus-attached manager publishes into the registry)."""
+        from hpbandster_tpu.obs.alerts import AlertManager
+        from hpbandster_tpu.obs.slo import BurnWindow, Selector, SLOSpec
+
+        reg = obs.MetricsRegistry()
+        bus = obs.EventBus()
+        spec = SLOSpec(
+            name="unit", objective=0.9, total=Selector("u"),
+            good_when=Selector(where=(("ok", True),)),
+            windows=(BurnWindow(10.0, 10.0, 2.0, "page"),),
+        )
+        mgr = AlertManager(specs=[spec], bus=bus, registry=reg)
+        for i in range(5):
+            mgr.process({"event": "u", "t_wall": float(i), "ok": False})
+        families = parse_prometheus_text(render_registry(reg))
+        assert families["hpbandster_slo_state"]["samples"] == [
+            ({"slo": "unit"}, 2.0)
+        ]
+        (labels, value), = families["hpbandster_slo_burn_rate"]["samples"]
+        assert labels == {"slo": "unit"} and value == 10.0
+        assert families["hpbandster_slo_alert_transitions_total"][
+            "samples"
+        ] == [({"slo": "unit"}, 1.0)]
+
     def test_publish_to_scrape_end_to_end(self):
         """publish_device_balance -> process registry -> scrape: the
         driver's gauges reach a scraper with no extra wiring."""

@@ -434,8 +434,10 @@ class TestEndToEnd:
         """ISSUE 19 acceptance: run a fused sweep (device metrics on, the
         8-device CPU mesh) with a journal attached; ``obs timeline``
         yields a Perfetto-loadable trace whose device rung slices are
-        correctly ordered, and ``obs critical-path`` attributes >= 95%
-        of the sweep's wall-clock to named phases."""
+        correctly ordered, and ``obs critical-path`` attributes >= 96%
+        of the sweep's wall-clock to named phases (tightened from 95%
+        once the batched journal sink took fsync stalls off the span
+        path — ISSUE 20 satellite)."""
         from hpbandster_tpu.optimizers import FusedBOHB
         from hpbandster_tpu.workloads.toys import (
             branin_from_vector,
@@ -461,11 +463,21 @@ class TestEndToEnd:
             finally:
                 detach()
                 journal.close()
+            return journal
 
         run_once(5)  # warm: the acceptance bar is the steady state —
         # first-in-process jax/XLA backend init is one-time, not sweep
         path = str(tmp_path / "journal.jsonl")
-        journaled_run(6, path)
+        journal = journaled_run(6, path)
+
+        # ISSUE 20 satellite: the sink batches micro-span writes behind
+        # chunk-close barriers — physical flushes stay far below the
+        # record count (write-through would make them equal)
+        with open(path, encoding="utf-8") as fh:
+            n_records = sum(1 for _ in fh)
+        assert 0 < journal.flushes < n_records, (
+            f"{journal.flushes} flushes for {n_records} records"
+        )
 
         out = str(tmp_path / "trace.json")
         assert obs_main(["timeline", path, "--out", out]) == 0
@@ -495,19 +507,21 @@ class TestEndToEnd:
         # flows stitched the sweep's trace_id across rows
         assert doc["otherData"]["flows"] >= 1
 
-        # critical path: >= 95% of the journaled wall attributed. One
-        # retry with a fresh journal damps shared-host scheduling noise
+        # critical path: >= 96% of the journaled wall attributed (the
+        # batched sink bought the extra point: per-record write+fsync
+        # used to ride between spans as unattributed gap). One retry
+        # with a fresh journal damps shared-host scheduling noise
         # (a ms-scale toy sweep; a single descheduling blip between two
         # spans can cost a percent) — the claim is about steady state.
         assert obs_main(["critical-path", path, "--json"]) == 0
         cp = json.loads(capsys.readouterr().out)
-        if cp["attributed_share"] < 0.95:
+        if cp["attributed_share"] < 0.96:
             path2 = str(tmp_path / "journal2.jsonl")
             journaled_run(7, path2)
             assert obs_main(["critical-path", path2, "--json"]) == 0
             cp = json.loads(capsys.readouterr().out)
         assert cp["end_to_end_s"] > 0
-        assert cp["attributed_share"] >= 0.95, format_critical_path(cp)
+        assert cp["attributed_share"] >= 0.96, format_critical_path(cp)
         assert cp["verdict"]["ok"] is True
         assert cp["phases"]["rung_compute"]["s"] > 0
 
